@@ -1,0 +1,40 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+Core abstractions (mirroring the reference, `README.rst:32-34`): **Tasks**
+(stateless remote functions), **Actors** (stateful worker processes), and
+**Objects** (immutable distributed values) — plus placement groups for gang
+scheduling and a JAX/XLA-first AI library stack (data, train, tune, rllib,
+serve) built on top of them.
+"""
+
+from ray_tpu.core.actor import ActorClass, ActorHandle, method
+from ray_tpu.core.generator import ObjectRefGenerator
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.remote_function import RemoteFunction, remote
+from ray_tpu.core.worker import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    shutdown,
+    timeline,
+    wait,
+)
+from ray_tpu.runtime_context import get_runtime_context
+from ray_tpu import exceptions
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ActorClass", "ActorHandle", "ObjectRef", "ObjectRefGenerator",
+    "RemoteFunction", "remote", "method", "init", "shutdown",
+    "is_initialized", "get", "put", "wait", "kill", "cancel", "get_actor",
+    "nodes", "cluster_resources", "available_resources", "timeline",
+    "get_runtime_context", "exceptions", "__version__",
+]

@@ -1,0 +1,121 @@
+"""Config flag registry.
+
+Equivalent of the reference's `RAY_CONFIG(type, name, default)` system
+(`src/ray/common/ray_config_def.h`, 209 entries materialized into a singleton,
+settable via `RAY_{name}` env vars and a `_system_config` dict from init).
+Here: typed declarations, `RAY_TPU_{NAME}` env overrides, and an
+`apply_system_config` hook from `ray_tpu.init(_system_config=...)`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    type: Callable[[str], Any]
+    doc: str
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+class Config:
+    """Singleton flag store. Declare with `_declare`, read as attributes."""
+
+    _flags: Dict[str, _Flag] = {}
+
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+
+    @classmethod
+    def _declare(cls, name: str, default: Any, doc: str = ""):
+        if isinstance(default, bool):
+            typ: Callable[[str], Any] = _parse_bool
+        elif isinstance(default, int):
+            typ = int
+        elif isinstance(default, float):
+            typ = float
+        else:
+            typ = str
+        cls._flags[name] = _Flag(name, default, typ, doc)
+
+    def __getattr__(self, name: str) -> Any:
+        flags = type(self)._flags
+        if name.startswith("_") or name not in flags:
+            raise AttributeError(name)
+        if name in self._values:
+            return self._values[name]
+        env = os.environ.get(_ENV_PREFIX + name.upper())
+        if env is not None:
+            return flags[name].type(env)
+        return flags[name].default
+
+    def apply_system_config(self, system_config: Dict[str, Any] | str | None):
+        if system_config is None:
+            return
+        if isinstance(system_config, str):
+            system_config = json.loads(system_config)
+        for k, v in system_config.items():
+            if k not in type(self)._flags:
+                raise ValueError(f"Unknown system config key: {k}")
+            self._values[k] = v
+
+    def serialize(self) -> str:
+        """Serialize overrides so child processes inherit driver-set config."""
+        return json.dumps(self._values)
+
+
+_D = Config._declare
+
+# -- core ---------------------------------------------------------------
+_D("max_direct_call_object_size", 100 * 1024,
+   "Objects <= this many bytes are returned inline / kept in the in-process "
+   "memory store instead of the shared-memory store (reference: "
+   "ray_config_def.h max_direct_call_object_size).")
+_D("object_store_memory_bytes", 2 * 1024**3,
+   "Default per-node object store capacity.")
+_D("object_store_full_delay_ms", 100, "Retry delay when the store is full.")
+_D("task_retry_delay_ms", 0, "Delay before retrying a failed task.")
+_D("max_task_retries_default", 3, "Default retries for idempotent tasks.")
+_D("worker_lease_timeout_ms", 30_000, "Lease request timeout.")
+_D("num_workers_soft_limit", 0, "0 = #CPUs on the node.")
+_D("worker_startup_timeout_s", 60.0, "Max time to wait for a worker process.")
+_D("health_check_period_ms", 1000,
+   "GCS->raylet health check interval (reference: gcs_health_check_manager).")
+_D("health_check_failure_threshold", 5,
+   "Missed health checks before a node is marked dead.")
+_D("gcs_rpc_timeout_s", 30.0, "Client-side timeout for GCS RPCs.")
+_D("raylet_heartbeat_period_ms", 250, "Raylet->GCS resource report interval.")
+_D("actor_restart_backoff_ms", 1000, "Backoff between actor restarts.")
+_D("metrics_report_interval_ms", 2000, "Metrics agent scrape/export interval.")
+_D("task_events_flush_interval_ms", 1000,
+   "Task event buffer flush interval (reference: task_event_buffer.h).")
+_D("max_pending_lease_requests_per_scheduling_category", 10,
+   "Pipelined lease requests per scheduling key (reference name identical).")
+_D("scheduler_spread_threshold", 0.5,
+   "Hybrid policy utilization threshold below which tasks pack on the local "
+   "node (reference: hybrid_scheduling_policy.h).")
+_D("object_timeout_ms", 100, "Plasma get poll interval.")
+_D("memory_monitor_refresh_ms", 250, "OOM monitor interval; 0 disables.")
+_D("memory_usage_threshold", 0.95, "Node memory fraction that triggers the OOM killer.")
+
+# -- tensor plane --------------------------------------------------------
+_D("tpu_slice_gang_scheduling", True,
+   "Treat a TPU slice as an atomic gang for placement-group scheduling.")
+_D("collective_timeout_s", 300.0, "Out-of-graph collective op timeout.")
+
+_config = Config()
+
+
+def ray_config() -> Config:
+    return _config

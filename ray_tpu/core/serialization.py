@@ -1,0 +1,126 @@
+"""Value serialization for the object store.
+
+Reference equivalent: `python/ray/_private/serialization.py` (cloudpickle +
+Arrow, zero-copy numpy). Design here: cloudpickle protocol-5 with out-of-band
+pickle buffers so large numpy / jax host arrays are written into the object
+store without an extra copy, and reads return views over shared memory.
+
+Wire format of a stored object:
+    [u32 metadata_len][metadata bytes (msgpack)] [pickled payload] [buffers...]
+metadata = {"nbuf": n, "buf_offsets": [...], "buf_lens": [...], "err": bool}
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional
+
+import cloudpickle
+import msgpack
+
+_HEADER = struct.Struct("<I")
+
+
+@dataclass
+class SerializedObject:
+    """A serialized value: an inline payload plus zero-copy buffer chunks."""
+
+    payload: bytes
+    buffers: List[memoryview]
+    is_error: bool = False
+
+    def total_size(self) -> int:
+        return (
+            _HEADER.size
+            + len(self._metadata())
+            + len(self.payload)
+            + sum(len(b) for b in self.buffers)
+        )
+
+    def _metadata(self) -> bytes:
+        lens = [len(b) for b in self.buffers]
+        return msgpack.packb(
+            {"nbuf": len(self.buffers), "buf_lens": lens,
+             "payload_len": len(self.payload), "err": self.is_error}
+        )
+
+    def to_bytes(self) -> bytes:
+        out = bytearray()
+        self.write_into(out)
+        return bytes(out)
+
+    def write_into(self, buf) -> None:
+        """Append the wire format into `buf` (bytearray or shm memoryview wrapper)."""
+        meta = self._metadata()
+        buf += _HEADER.pack(len(meta))
+        buf += meta
+        buf += self.payload
+        for b in self.buffers:
+            buf += b
+
+
+def serialize(value: Any, *,
+              ref_serializer: Optional[Callable[[Any], None]] = None
+              ) -> SerializedObject:
+    """Serialize `value`; large contiguous buffers are captured out-of-band.
+
+    `ref_serializer` is called on every ObjectRef contained in the value so the
+    owner can run the borrowing protocol (reference:
+    `reference_count.h` borrowed-refs / `serialization.py` object-ref hooks).
+    """
+    buffers: List[pickle.PickleBuffer] = []
+
+    def buffer_callback(pb: pickle.PickleBuffer) -> bool:
+        raw = pb.raw()
+        if raw.nbytes >= 4096 and raw.contiguous:
+            buffers.append(pb)
+            return False  # keep out-of-band
+        return True  # in-band
+
+    from ray_tpu.core.object_ref import ObjectRef, _serialization_context
+
+    with _serialization_context(ref_serializer):
+        payload = cloudpickle.dumps(
+            value, protocol=5, buffer_callback=buffer_callback)
+    views = [pb.raw() for pb in buffers]
+    return SerializedObject(payload=payload, buffers=views)
+
+
+def serialize_error(exc: BaseException) -> SerializedObject:
+    try:
+        so = serialize(exc)
+    except Exception:
+        from ray_tpu.exceptions import RaySystemError
+        so = serialize(RaySystemError(f"Unserializable exception: {exc!r}"))
+    so.is_error = True
+    return so
+
+
+def deserialize(data, *,
+                ref_deserializer: Optional[Callable[[Any], None]] = None,
+                raise_errors: bool = True) -> Any:
+    """Deserialize from a bytes-like (possibly a zero-copy shm memoryview)."""
+    view = memoryview(data)
+    (meta_len,) = _HEADER.unpack_from(view, 0)
+    off = _HEADER.size
+    meta = msgpack.unpackb(bytes(view[off:off + meta_len]))
+    off += meta_len
+    payload = view[off:off + meta["payload_len"]]
+    off += meta["payload_len"]
+    buffers = []
+    for blen in meta["buf_lens"]:
+        buffers.append(view[off:off + blen])
+        off += blen
+
+    from ray_tpu.core.object_ref import _serialization_context
+
+    with _serialization_context(ref_deserializer):
+        value = pickle.loads(payload, buffers=buffers)
+    if meta.get("err") and raise_errors:
+        from ray_tpu.exceptions import RayTaskError
+        if isinstance(value, RayTaskError):
+            raise value.as_instanceof_cause()
+        raise value
+    return value

@@ -1,0 +1,150 @@
+"""Global driver/worker state and the public module-level API.
+
+Reference equivalent: `python/ray/_private/worker.py` — the `Worker` singleton
+behind `ray.init` (`:1152`), `ray.get/put/wait`, `ray.kill`, etc.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence, Union
+
+from ray_tpu.core.object_ref import ObjectRef
+
+_global_lock = threading.RLock()
+_runtime = None
+
+
+class _AutoInitError(RuntimeError):
+    pass
+
+
+def current_runtime(or_none: bool = False):
+    global _runtime
+    with _global_lock:
+        if _runtime is None:
+            if or_none:
+                return None
+            # Auto-init, like the reference's implicit ray.init() on first API use.
+            init()
+        return _runtime
+
+
+def set_runtime(rt) -> None:
+    global _runtime
+    with _global_lock:
+        _runtime = rt
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+def init(address: Optional[str] = None, *,
+         num_cpus: Optional[int] = None,
+         num_gpus: Optional[int] = None,
+         resources: Optional[dict] = None,
+         local_mode: bool = False,
+         namespace: Optional[str] = None,
+         runtime_env: Optional[dict] = None,
+         object_store_memory: Optional[int] = None,
+         ignore_reinit_error: bool = False,
+         include_dashboard: Optional[bool] = None,
+         dashboard_port: Optional[int] = None,
+         log_to_driver: bool = True,
+         _system_config: Optional[dict] = None,
+         **kwargs: Any):
+    """Connect to (or start) a cluster. Reference: _private/worker.py:1152."""
+    global _runtime
+    with _global_lock:
+        if _runtime is not None:
+            if ignore_reinit_error:
+                return _runtime
+            raise RuntimeError(
+                "ray_tpu.init() was already called. Pass "
+                "ignore_reinit_error=True to ignore.")
+        from ray_tpu.core.config import ray_config
+        ray_config().apply_system_config(_system_config)
+
+        if local_mode:
+            from ray_tpu.core.local_mode import LocalModeRuntime
+            _runtime = LocalModeRuntime(num_cpus=num_cpus, namespace=namespace)
+        else:
+            try:
+                from ray_tpu.core.cluster_runtime import ClusterRuntime
+            except ImportError:
+                # Cluster runtime not available in this build: degrade to the
+                # in-process runtime (same API surface) with a warning.
+                import warnings
+                warnings.warn(
+                    "cluster runtime unavailable; falling back to local mode",
+                    stacklevel=2)
+                from ray_tpu.core.local_mode import LocalModeRuntime
+                _runtime = LocalModeRuntime(
+                    num_cpus=num_cpus, namespace=namespace)
+                return _runtime
+            _runtime = ClusterRuntime.connect_or_start(
+                address=address, num_cpus=num_cpus, num_gpus=num_gpus,
+                resources=resources, namespace=namespace,
+                object_store_memory=object_store_memory,
+                runtime_env=runtime_env,
+                include_dashboard=include_dashboard,
+                dashboard_port=dashboard_port,
+                log_to_driver=log_to_driver)
+        return _runtime
+
+
+def shutdown() -> None:
+    global _runtime
+    with _global_lock:
+        if _runtime is not None:
+            _runtime.shutdown()
+            _runtime = None
+
+
+def put(value: Any) -> ObjectRef:
+    return current_runtime().put(value)
+
+
+def get(object_refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    return current_runtime().get(object_refs, timeout=timeout)
+
+
+def wait(object_refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    return current_runtime().wait(
+        object_refs, num_returns=num_returns, timeout=timeout,
+        fetch_local=fetch_local)
+
+
+def kill(actor, *, no_restart: bool = True) -> None:
+    current_runtime().kill_actor(actor, no_restart=no_restart)
+
+
+def cancel(object_ref: ObjectRef, *, force: bool = False,
+           recursive: bool = True) -> None:
+    current_runtime().cancel(object_ref, force=force, recursive=recursive)
+
+
+def get_actor(name: str, namespace: Optional[str] = None):
+    return current_runtime().get_actor(name, namespace=namespace)
+
+
+def nodes() -> List[dict]:
+    return current_runtime().nodes()
+
+
+def cluster_resources() -> dict:
+    return current_runtime().cluster_resources()
+
+
+def available_resources() -> dict:
+    return current_runtime().available_resources()
+
+
+def timeline(filename: Optional[str] = None):
+    rt = current_runtime()
+    if hasattr(rt, "timeline"):
+        return rt.timeline(filename)
+    return []

@@ -1,0 +1,139 @@
+"""Lazy call-graph (DAG) API.
+
+Reference equivalent: `python/ray/dag/` (`DAGNode`/`FunctionNode`/`ClassNode`/
+`InputNode`, `dag/__init__.py:1-9`) — the base for Serve deployment graphs and
+Workflows. `f.bind(x)` builds nodes; `dag.execute(inp)` walks the graph
+submitting tasks/actor calls bottom-up.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class DAGNode:
+    """A node in a lazy call graph; children are found in args/kwargs."""
+
+    def __init__(self, args: Tuple, kwargs: Dict[str, Any]):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    # -- traversal -------------------------------------------------------
+    def _children(self) -> List["DAGNode"]:
+        out = [a for a in self._bound_args if isinstance(a, DAGNode)]
+        out += [v for v in self._bound_kwargs.values() if isinstance(v, DAGNode)]
+        return out
+
+    def _resolve_args(self, memo: Dict[int, Any], input_value: Any):
+        def res(v):
+            if isinstance(v, DAGNode):
+                return v._execute_memo(memo, input_value)
+            return v
+
+        args = tuple(res(a) for a in self._bound_args)
+        kwargs = {k: res(v) for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def _execute_memo(self, memo: Dict[int, Any], input_value: Any):
+        if id(self) in memo:
+            return memo[id(self)]
+        out = self._execute_impl(memo, input_value)
+        memo[id(self)] = out
+        return out
+
+    def _execute_impl(self, memo, input_value):
+        raise NotImplementedError
+
+    def execute(self, input_value: Any = None):
+        """Execute the graph; returns the root's ObjectRef/handle."""
+        return self._execute_memo({}, input_value)
+
+
+class InputNode(DAGNode):
+    """Placeholder for the runtime input (reference: dag/input_node.py).
+
+    Supports `with InputNode() as inp:` style used by Serve graph builds.
+    """
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _execute_impl(self, memo, input_value):
+        return input_value
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_function, args, kwargs, options):
+        super().__init__(args, kwargs)
+        self._remote_function = remote_function
+        self._options = options
+
+    def _execute_impl(self, memo, input_value):
+        args, kwargs = self._resolve_args(memo, input_value)
+        return self._remote_function._remote(args, kwargs, self._options)
+
+
+class ClassNode(DAGNode):
+    """A bound actor class; executing instantiates the actor."""
+
+    def __init__(self, actor_class, args, kwargs, options):
+        super().__init__(args, kwargs)
+        self._actor_class = actor_class
+        self._options = options
+        self._cached_handle = None
+
+    def _execute_impl(self, memo, input_value):
+        if self._cached_handle is None:
+            args, kwargs = self._resolve_args(memo, input_value)
+            self._cached_handle = self._actor_class._remote(
+                args, kwargs, self._options)
+        return self._cached_handle
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _UnboundClassMethod(self, name)
+
+
+class _UnboundClassMethod:
+    def __init__(self, class_node: ClassNode, method_name: str):
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def bind(self, *args, **kwargs):
+        return ClassMethodNode(self._class_node, self._method_name, args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, actor_or_node, method_name, args, kwargs,
+                 options=None):
+        super().__init__(args, kwargs)
+        self._actor = actor_or_node
+        self._method_name = method_name
+        self._options = options
+
+    def _children(self):
+        out = super()._children()
+        if isinstance(self._actor, DAGNode):
+            out.append(self._actor)
+        return out
+
+    def _execute_impl(self, memo, input_value):
+        actor = self._actor
+        if isinstance(actor, DAGNode):
+            actor = actor._execute_memo(memo, input_value)
+        args, kwargs = self._resolve_args(memo, input_value)
+        if self._options is not None:
+            return actor._submit(self._method_name, args, kwargs,
+                                 self._options)
+        return getattr(actor, self._method_name).remote(*args, **kwargs)
+
+
+__all__ = ["DAGNode", "InputNode", "FunctionNode", "ClassNode",
+           "ClassMethodNode"]

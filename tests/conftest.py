@@ -1,0 +1,53 @@
+"""Shared pytest fixtures.
+
+Mirrors the reference's conftest strategy (`python/ray/tests/conftest.py`):
+fixtures that boot a real runtime per test, plus the TPU-less trick from
+SURVEY.md §4.2 — JAX pinned to CPU with 8 virtual devices so mesh/sharding
+tests run anywhere (`xla_force_host_platform_device_count`).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_local():
+    """Local-mode runtime (reference fixture analog: ray_start_regular)."""
+    import ray_tpu
+
+    ray_tpu.init(local_mode=True, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Single-node cluster runtime (head + raylet + workers as processes)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def cpu_mesh8():
+    """An 8-device CPU mesh for sharding tests."""
+    import jax
+
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, (
+        "conftest must run before jax import; got %d devices" % len(devices))
+    from jax.sharding import Mesh
+    import numpy as np
+
+    return Mesh(np.array(devices[:8]).reshape(2, 2, 2), ("dp", "sp", "tp"))

@@ -1,0 +1,427 @@
+"""Core API semantics in local mode.
+
+Modeled on the reference's `python/ray/tests/test_basic.py` / `test_actor.py`
+coverage classes: tasks, multiple returns, errors, wait, actors, named actors,
+async actors, streaming generators, serialization of refs.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+
+def test_put_get(ray_start_local):
+    ray = ray_start_local
+    ref = ray.put(42)
+    assert ray.get(ref) == 42
+    arr = np.arange(100000, dtype=np.float32)
+    ref2 = ray.put(arr)
+    out = ray.get(ref2)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_task_basic(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def add(a, b):
+        return a + b
+
+    assert ray.get(add.remote(1, 2)) == 3
+    # chained refs as args
+    r = add.remote(add.remote(1, 2), 3)
+    assert ray.get(r) == 6
+
+
+def test_task_multiple_returns(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_options_override(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def two():
+        return 1, 2
+
+    a, b = two.options(num_returns=2).remote()
+    assert ray.get(a) == 1 and ray.get(b) == 2
+
+
+def test_task_error_propagates(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def boom():
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError, match="boom"):
+        ray.get(boom.remote())
+
+
+def test_error_chains_through_dependency(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def boom():
+        raise KeyError("k")
+
+    @ray.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(Exception):
+        ray.get(consume.remote(boom.remote()))
+
+
+def test_wait(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def fast():
+        return "fast"
+
+    @ray.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray.wait([f, s], num_returns=1, timeout=3)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_get_timeout(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(ray.exceptions.GetTimeoutError):
+        ray.get(slow.remote(), timeout=0.2)
+
+
+def test_actor_basic(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, by=1):
+            self.n += by
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray.get(c.incr.remote()) == 11
+    assert ray.get(c.incr.remote(5)) == 16
+    assert ray.get(c.value.remote()) == 16
+
+
+def test_actor_ordering(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+
+        def get(self):
+            return self.items
+
+    a = Appender.remote()
+    for i in range(50):
+        a.add.remote(i)
+    assert ray.get(a.get.remote()) == list(range(50))
+
+
+def test_named_actor(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    Svc.options(name="svc").remote()
+    h = ray.get_actor("svc")
+    assert ray.get(h.ping.remote()) == "pong"
+    with pytest.raises(ValueError):
+        Svc.options(name="svc").remote()
+
+
+def test_kill_actor(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class A:
+        def f(self):
+            return 1
+
+    a = A.remote()
+    assert ray.get(a.f.remote()) == 1
+    ray.kill(a)
+    with pytest.raises(ray.exceptions.RayActorError):
+        ray.get(a.f.remote())
+
+
+def test_async_actor(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class AsyncActor:
+        async def work(self, x):
+            import asyncio
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncActor.remote()
+    refs = [a.work.remote(i) for i in range(10)]
+    assert ray.get(refs) == [i * 2 for i in range(10)]
+
+
+def test_streaming_generator(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    out = [ray.get(ref) for ref in gen.remote(5)]
+    assert out == [0, 1, 4, 9, 16]
+
+
+def test_ref_in_object(ray_start_local):
+    ray = ray_start_local
+    inner = ray.put("inner-value")
+    outer = ray.put({"ref": inner})
+    got = ray.get(outer)
+    assert ray.get(got["ref"]) == "inner-value"
+
+
+def test_actor_handle_passing(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    @ray.remote
+    def use(counter):
+        return ray.get(counter.incr.remote())
+
+    c = Counter.remote()
+    assert ray.get(use.remote(c)) == 1
+    assert ray.get(use.remote(c)) == 2
+
+
+def test_dag_bind_execute(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def double(x):
+        return x * 2
+
+    @ray.remote
+    def add(a, b):
+        return a + b
+
+    from ray_tpu.dag import InputNode
+
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), inp)
+    assert ray.get(dag.execute(5)) == 15
+
+
+def test_nodes_and_resources(ray_start_local):
+    ray = ray_start_local
+    ns = ray.nodes()
+    assert len(ns) == 1 and ns[0]["Alive"]
+    assert ray.cluster_resources()["CPU"] >= 1
+
+
+def test_cannot_call_remote_directly(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_failing_actor_ctor_does_not_leak_name(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class Bad:
+        def __init__(self, ok):
+            if not ok:
+                raise RuntimeError("ctor boom")
+
+        def ping(self):
+            return "pong"
+
+    with pytest.raises(RuntimeError):
+        Bad.options(name="svc2").remote(False)
+    # Name must be reusable after the failed construction.
+    Bad.options(name="svc2").remote(True)
+    assert ray.get(ray.get_actor("svc2").ping.remote()) == "pong"
+
+
+def test_cancel_resolves_all_sibling_returns(ray_start_local):
+    ray = ray_start_local
+    import threading
+
+    gate = threading.Event()
+
+    @ray.remote
+    def block():
+        gate.wait(30)
+
+    # Saturate the pool so the next task stays queued and is cancellable.
+    blockers = [block.remote() for _ in range(64)]
+
+    @ray.remote(num_returns=2)
+    def two():
+        return 1, 2
+
+    a, b = two.remote()
+    ray.cancel(a)
+    gate.set()
+    try:
+        with pytest.raises(ray.exceptions.TaskCancelledError):
+            ray.get(a, timeout=5)
+        with pytest.raises(ray.exceptions.TaskCancelledError):
+            ray.get(b, timeout=5)
+    except ray.exceptions.GetTimeoutError:
+        pytest.fail("sibling return ref never resolved after cancel")
+    finally:
+        ray.get(blockers, timeout=30)
+
+
+def test_actor_streaming_method(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class Gen:
+        def produce(self, n):
+            for i in range(n):
+                yield i * 10
+
+    g = Gen.remote()
+    out = [ray.get(r) for r in g.produce.options(
+        num_returns="streaming").remote(4)]
+    assert out == [0, 10, 20, 30]
+
+
+def test_runtime_context_in_task_and_actor(ray_start_local):
+    ray = ray_start_local
+    from ray_tpu import get_runtime_context
+
+    @ray.remote
+    def tid():
+        return get_runtime_context().get_task_id()
+
+    assert ray.get(tid.remote()) is not None
+
+    @ray.remote
+    class A:
+        def me(self):
+            return get_runtime_context().get_actor_id()
+
+    a = A.remote()
+    assert ray.get(a.me.remote()) == a._ray_actor_id.hex()
+
+
+def test_nested_get_no_deadlock(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def chain(n):
+        if n == 0:
+            return 0
+        return ray.get(chain.remote(n - 1)) + 1
+
+    # Depth well beyond the base pool size: elastic pool must grow.
+    assert ray.get(chain.remote(30), timeout=60) == 30
+
+
+def test_async_actor_runtime_context(ray_start_local):
+    ray = ray_start_local
+    from ray_tpu import get_runtime_context
+
+    @ray.remote
+    class A:
+        async def me(self):
+            return get_runtime_context().get_actor_id()
+
+    a = A.remote()
+    assert ray.get(a.me.remote()) == a._ray_actor_id.hex()
+
+
+def test_async_generator_actor_method(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    class AGen:
+        async def produce(self, n):
+            for i in range(n):
+                yield i + 100
+
+    g = AGen.remote()
+    out = [ray.get(r) for r in
+           g.produce.options(num_returns="streaming").remote(3)]
+    assert out == [100, 101, 102]
+
+
+def test_dag_options_propagate(ray_start_local):
+    ray = ray_start_local
+
+    @ray.remote
+    def two():
+        return 1, 2
+
+    node = two.options(num_returns=2).bind()
+    a, b = node.execute()
+    assert ray.get(a) == 1 and ray.get(b) == 2
+
+
+def test_object_released_on_ref_drop(ray_start_local):
+    ray = ray_start_local
+    rt = ray.get_runtime_context  # noqa: F841 (just to touch API)
+    from ray_tpu.core.worker import current_runtime
+
+    runtime = current_runtime()
+    before = len(runtime._objects)
+    for _ in range(20):
+        ref = ray.put(b"x" * 10000)
+        ray.get(ref)
+        del ref
+    import gc
+    gc.collect()
+    assert len(runtime._objects) <= before + 2
