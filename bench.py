@@ -1,0 +1,74 @@
+"""Benchmark: flagship LM training throughput on the local TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no absolute ML-throughput numbers in-repo
+(BASELINE.md — `published: {}`); its GPT-class benchmark is tracked in CI
+only. So `vs_baseline` here is reported as model-FLOPs utilization (MFU)
+against the chip's bf16 peak — a hardware-honest denominator that can only be
+compared apples-to-apples: reference DeepSpeed GPT fine-tunes on A100s land
+around 0.30-0.45 MFU, so vs_baseline >= ~0.35 means we match or beat the
+reference's efficiency on our silicon.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import TransformerConfig, init_params
+    from ray_tpu.models.transformer import lm_loss
+    from ray_tpu.parallel.spmd import make_train_step
+
+    backend = jax.default_backend()
+    # GPT-small-class model; bf16 compute, fits a single v5e chip.
+    cfg = TransformerConfig(
+        vocab_size=32768, d_model=768, n_layers=12, n_heads=12, d_ff=2048,
+        max_seq_len=1024, dtype=jnp.bfloat16, remat=True)
+    batch, seq = (16, 1024) if backend == "tpu" else (2, 128)
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    optimizer = optax.adamw(1e-4)
+    opt_state = optimizer.init(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size, "int32")
+    train_batch = {"tokens": tokens}
+
+    step = make_train_step(lambda p, b: lm_loss(p, b, cfg), optimizer)
+
+    # Warmup/compile. NOTE: float(loss) (device->host transfer) is the sync
+    # point — block_until_ready is unreliable on tunneled backends.
+    params, opt_state, loss = step(params, opt_state, train_batch)
+    float(loss)
+
+    iters = 10 if backend == "tpu" else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, train_batch)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * iters / dt
+
+    # MFU: 6*N FLOPs/token (fwd+bwd), v5e bf16 peak 197 TFLOP/s.
+    peak = 197e12 if backend == "tpu" else 1e12
+    mfu = (6.0 * n_params * tokens_per_sec) / peak
+
+    print(json.dumps({
+        "metric": "lm_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": f"tokens/s ({n_params/1e6:.0f}M-param LM, {backend})",
+        "vs_baseline": round(mfu, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

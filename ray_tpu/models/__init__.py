@@ -1,0 +1,21 @@
+"""Model zoo: flagship decoder LM (dense + MoE), MLPs, RL networks.
+
+Models are pure-JAX functional: ``init(key, cfg) -> params pytree``,
+``forward(params, inputs, cfg, mesh) -> outputs``, with a parallel
+``param_specs(cfg) -> PartitionSpec pytree`` giving the GSPMD shardings for
+every weight (dp=FSDP/ZeRO shard axis, tp=Megatron row/col, sp=sequence,
+experts over dp).
+"""
+
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    forward,
+    init_params,
+    param_specs,
+)
+from ray_tpu.models.mlp import MLPConfig, mlp_forward, mlp_init
+
+__all__ = [
+    "TransformerConfig", "init_params", "param_specs", "forward",
+    "MLPConfig", "mlp_init", "mlp_forward",
+]

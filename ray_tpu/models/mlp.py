@@ -1,0 +1,38 @@
+"""Simple MLP (MNIST-class): the minimum end-to-end training slice
+(SURVEY.md §7.6 / BASELINE.json config #2)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: Sequence[int] = (256, 256)
+    out_dim: int = 10
+
+
+def mlp_init(key, cfg: MLPConfig):
+    dims = [cfg.in_dim, *cfg.hidden, cfg.out_dim]
+    params = []
+    for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+        key, sub = jax.random.split(key)
+        params.append({
+            "w": jax.random.normal(sub, (a, b), jnp.float32)
+            * (2.0 / a) ** 0.5,
+            "b": jnp.zeros((b,), jnp.float32),
+        })
+    return params
+
+
+def mlp_forward(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x

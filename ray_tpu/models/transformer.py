@@ -1,0 +1,274 @@
+"""Flagship decoder-only transformer LM, designed TPU-first.
+
+Covers the reference's GPT-J-6B fine-tune role (BASELINE.md: DeepSpeed ZeRO-3
+on GPUs, `release/release_tests.yaml:850-869`) the TPU way:
+
+- GSPMD shardings on every weight (``param_specs``): FSDP/ZeRO over ``dp``,
+  Megatron row/col over ``tp`` — zero-redundancy comes from the SPMD
+  partitioner, not an optimizer-state wrapper.
+- sequence parallelism: ring attention over ``sp`` (ops/attention.py).
+- optional MoE layers with experts sharded over ``dp`` (ops/moe.py).
+- layers stacked and scanned (`lax.scan`) for O(1) compile time in depth;
+  `jax.checkpoint` rematerialization per layer when ``remat=True``.
+- bfloat16 activations, float32 params/accumulators (MXU-friendly).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.moe import moe_ffn
+from ray_tpu.ops.rotary import apply_rotary, rotary_freqs
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 1376
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    num_experts: int = 0          # 0 => dense FFN in every layer
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16     # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    aux_loss_weight: float = 0.01
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 1
+
+
+Params = Dict[str, Any]
+
+
+def init_params(key, cfg: TransformerConfig) -> Params:
+    d, f, h, v, l = (cfg.d_model, cfg.d_ff, cfg.n_heads * cfg.head_dim,
+                     cfg.vocab_size, cfg.n_layers)
+
+    def norm(key, shape, fan_in):
+        return (jax.random.normal(key, shape, cfg.param_dtype)
+                * (1.0 / fan_in) ** 0.5)
+
+    keys = iter(jax.random.split(key, 16))
+    layers: Dict[str, jax.Array] = {
+        "ln1": jnp.ones((l, d), cfg.param_dtype),
+        "wq": norm(next(keys), (l, d, h), d),
+        "wk": norm(next(keys), (l, d, h), d),
+        "wv": norm(next(keys), (l, d, h), d),
+        "wo": norm(next(keys), (l, h, d), h),
+        "ln2": jnp.ones((l, d), cfg.param_dtype),
+    }
+    if cfg.is_moe:
+        e = cfg.num_experts
+        layers["router"] = norm(next(keys), (l, d, e), d)
+        layers["moe_w1"] = norm(next(keys), (l, e, d, f), d)
+        layers["moe_w2"] = norm(next(keys), (l, e, f, d), f)
+    else:
+        layers["w1"] = norm(next(keys), (l, d, f), d)
+        layers["w3"] = norm(next(keys), (l, d, f), d)
+        layers["w2"] = norm(next(keys), (l, f, d), f)
+    return {
+        "embed": norm(next(keys), (v, d), d),
+        "layers": layers,
+        "ln_f": jnp.ones((d,), cfg.param_dtype),
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> Params:
+    """PartitionSpec pytree mirroring `init_params` (dp=FSDP, tp=Megatron;
+    layer-stack dim unsharded; experts over dp)."""
+    layers: Dict[str, P] = {
+        "ln1": P(None, None),
+        "wq": P(None, "dp", "tp"),
+        "wk": P(None, "dp", "tp"),
+        "wv": P(None, "dp", "tp"),
+        "wo": P(None, "tp", "dp"),
+        "ln2": P(None, None),
+    }
+    if cfg.is_moe:
+        layers["router"] = P(None, None, None)
+        layers["moe_w1"] = P(None, "dp", None, "tp")
+        layers["moe_w2"] = P(None, "dp", "tp", None)
+    else:
+        layers["w1"] = P(None, "dp", "tp")
+        layers["w3"] = P(None, "dp", "tp")
+        layers["w2"] = P(None, "tp", "dp")
+    return {
+        "embed": P("tp", "dp"),
+        "layers": layers,
+        "ln_f": P(None),
+    }
+
+
+def _rmsnorm(x, scale, eps=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _layer(x, lp, cfg: TransformerConfig, mesh, manual_sp, cos, sin,
+           positions):
+    b, s, d = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+    act = cfg.dtype
+
+    # -- attention block -----------------------------------------------
+    y = _rmsnorm(x, lp["ln1"])
+    q = (y @ lp["wq"].astype(act)).reshape(b, s, h, hd)
+    k = (y @ lp["wk"].astype(act)).reshape(b, s, h, hd)
+    v = (y @ lp["wv"].astype(act)).reshape(b, s, h, hd)
+    q = apply_rotary(q, cos, sin, positions)
+    k = apply_rotary(k, cos, sin, positions)
+    if mesh is not None and not manual_sp:
+        from jax.sharding import NamedSharding
+        qkv_spec = NamedSharding(mesh, P("dp", "sp", "tp", None))
+        q, k, v = (jax.lax.with_sharding_constraint(t, qkv_spec)
+                   for t in (q, k, v))
+    o = attention(q, k, v, causal=True, mesh=mesh, positions=positions,
+                  manual_sp=manual_sp)
+    x = x + (o.reshape(b, s, h * hd) @ lp["wo"].astype(act))
+
+    # -- FFN block ------------------------------------------------------
+    y = _rmsnorm(x, lp["ln2"])
+    if cfg.is_moe:
+        ff, aux = moe_ffn(y, lp["router"], lp["moe_w1"], lp["moe_w2"],
+                          top_k=cfg.moe_top_k,
+                          capacity_factor=cfg.capacity_factor)
+    else:
+        gate = jax.nn.silu(y @ lp["w1"].astype(act))
+        up = y @ lp["w3"].astype(act)
+        ff = (gate * up) @ lp["w2"].astype(act)
+        aux = jnp.zeros((), jnp.float32)
+    x = x + ff
+    if mesh is not None and not manual_sp:
+        from jax.sharding import NamedSharding
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("dp", "sp", None)))
+    return x, aux
+
+
+def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
+            mesh=None, positions: Optional[jax.Array] = None
+            ) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B,S] int32 -> (logits [B,S,V], aux_loss scalar)."""
+    act = cfg.dtype
+    x = jnp.take(params["embed"], tokens, axis=0).astype(act)
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("dp", "sp", None)))
+    cos, sin = rotary_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1])
+
+    def scan_body(carry, lp):
+        fn = _layer
+        if cfg.remat:
+            fn = jax.checkpoint(_layer, static_argnums=(2, 3, 4))
+        x_new, aux = fn(carry, lp, cfg, mesh, False, cos, sin, positions)
+        return x_new, aux
+
+    x, auxes = jax.lax.scan(scan_body, x, params["layers"])
+    x = _rmsnorm(x, params["ln_f"])
+    logits = x @ params["embed"].T.astype(act)  # tied embeddings
+    return logits.astype(jnp.float32), jnp.sum(auxes)
+
+
+def to_pipelined(params: Params, n_stages: int) -> Params:
+    """Reshape stacked layer leaves [L, ...] -> [n_stages, L/n_stages, ...]
+    for pipeline-parallel execution (leading dim sharded over ``pp``)."""
+    out = dict(params)
+    out["layers"] = jax.tree.map(
+        lambda a: a.reshape(n_stages, a.shape[0] // n_stages, *a.shape[1:]),
+        params["layers"])
+    return out
+
+
+def pipelined_param_specs(cfg: TransformerConfig) -> Params:
+    """Specs matching `to_pipelined` output: layer leaves gain a leading
+    ``pp`` dim; the original per-layer spec shifts right (its leading
+    layer-stack dim was already None)."""
+    base = param_specs(cfg)
+    base["layers"] = {k: P("pp", *s) for k, s in base["layers"].items()}
+    return base
+
+
+def forward_pipelined(params: Params, tokens: jax.Array,
+                      cfg: TransformerConfig, mesh,
+                      num_microbatches: int = 2,
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Pipeline-parallel forward: embed/head replicated over ``pp``, layer
+    stages flow through the GPipe schedule (parallel/pipeline.py), with
+    ring-attention sequence parallelism fused into the same manual shard_map
+    when the mesh has sp > 1."""
+    from ray_tpu.parallel.pipeline import gpipe
+
+    act = cfg.dtype
+    x = jnp.take(params["embed"], tokens, axis=0).astype(act)
+    positions = jnp.arange(tokens.shape[1])
+    manual_sp = "sp" in mesh.axis_names and mesh.shape["sp"] > 1
+
+    rope = rotary_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+    def stage_fn(stage_layers, x_mb, pos, consts):
+        cos, sin = consts
+
+        def body(carry, lp):
+            fn = _layer
+            if cfg.remat:
+                fn = jax.checkpoint(_layer, static_argnums=(2, 3, 4))
+            x_new, aux = fn(carry, lp, cfg, mesh, manual_sp, cos, sin, pos)
+            return x_new, aux
+
+        x_out, auxes = jax.lax.scan(body, x_mb, stage_layers)
+        return x_out, jnp.sum(auxes)
+
+    x, aux = gpipe(stage_fn, params["layers"], x, positions, rope, mesh=mesh,
+                   num_microbatches=num_microbatches)
+    x = _rmsnorm(x, params["ln_f"])
+    logits = x @ params["embed"].T.astype(act)
+    return logits.astype(jnp.float32), aux
+
+
+def _token_nll(logits, targets, mask=None) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        return jnp.mean(nll)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def lm_loss(params: Params, batch: Dict[str, jax.Array],
+            cfg: TransformerConfig, mesh=None) -> jax.Array:
+    """Next-token cross-entropy; batch = {"tokens": [B,S+1] int32,
+    optional "mask": [B,S]}."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits, aux = forward(params, inputs, cfg, mesh)
+    loss = _token_nll(logits, targets, batch.get("mask"))
+    return loss + cfg.aux_loss_weight * aux
+
+
+def lm_loss_pipelined(params: Params, batch: Dict[str, jax.Array],
+                      cfg: TransformerConfig, mesh,
+                      num_microbatches: int = 2) -> jax.Array:
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits, aux = forward_pipelined(params, inputs, cfg, mesh,
+                                    num_microbatches=num_microbatches)
+    loss = _token_nll(logits, targets, batch.get("mask"))
+    return loss + cfg.aux_loss_weight * aux

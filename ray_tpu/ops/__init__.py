@@ -1,0 +1,12 @@
+"""TPU-native ops: attention (plain/ring), MoE dispatch, rotary embeddings.
+
+The reference has no sequence-parallel or long-context kernels anywhere
+(SURVEY.md §2.5 — ring attention/Ulysses absent, delegated to DeepSpeed user
+code); these are designed new for the ICI mesh.
+"""
+
+from ray_tpu.ops.attention import attention, plain_attention, ring_attention
+from ray_tpu.ops.rotary import apply_rotary, rotary_freqs
+
+__all__ = ["attention", "plain_attention", "ring_attention",
+           "apply_rotary", "rotary_freqs"]

@@ -1,0 +1,122 @@
+"""Attention: plain (XLA-fused) and ring (sequence-parallel over ICI).
+
+Ring attention (SURVEY.md §2.5 / §5 — absent from the reference, built new):
+each ``sp`` rank holds one sequence block of Q/K/V; K/V blocks rotate around
+the ring via ``ppermute`` while a flash-style online softmax accumulates
+output — so attention over sequence length S costs O(S/P) memory per chip and
+overlaps compute with neighbor-to-neighbor ICI transfers. Differentiable
+(autodiff through the scan; the ppermute transpose is the reverse rotation).
+
+Position bookkeeping travels *with* the ring: each K/V block's global
+positions are ppermuted alongside it, so the same body works standalone
+(`ring_attention`) or inside an enclosing manual shard_map that also handles
+pipeline stages (`ring_attention_manual`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def plain_attention(q, k, v, *, causal: bool = True, positions=None):
+    """Softmax attention. q/k/v: [B, S, H, D]; positions: [S] global indices
+    for the causal mask (defaults to arange)."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        idx = jnp.arange(q.shape[1]) if positions is None else positions
+        mask = idx[:, None] >= idx[None, :]
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ring_attention_manual(q, k, v, q_pos, *, axis_name: str = "sp",
+                          causal: bool = True):
+    """Manual-collective ring attention body. Must run inside a shard_map
+    where `axis_name` is a manual axis. q/k/v: local blocks [B, S_loc, H, D];
+    q_pos: [S_loc] global positions of the local block."""
+    axis_size = jax.lax.axis_size(axis_name)
+    b, s_loc, h, d = q.shape
+    scale = d ** -0.5
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    qf = q.astype(jnp.float32)
+
+    def step(carry, _):
+        o, l, m, k_blk, v_blk, kv_pos = carry
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                            k_blk.astype(jnp.float32)) * scale
+        if causal:
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        m_blk = jnp.max(scores, axis=-1)                     # [B,H,Q]
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(scores - m_new[..., None])               # [B,H,Q,K]
+        corr = jnp.exp(m - m_new)                            # [B,H,Q]
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = (o * corr[..., None]
+                 + jnp.einsum("bhqk,bkhd->bhqd", p,
+                              v_blk.astype(jnp.float32)))
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        pos_next = jax.lax.ppermute(kv_pos, axis_name, perm)
+        return (o_new, l_new, m_new, k_next, v_next, pos_next), None
+
+    o0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    m0 = jnp.full((b, h, s_loc), _NEG_INF, jnp.float32)
+    (o, l, m, _, _, _), _ = jax.lax.scan(
+        step, (o0, l0, m0, k, v, q_pos), None, length=axis_size)
+    l = jnp.maximum(l, 1e-20)
+    out = (o / l[..., None]).transpose(0, 2, 1, 3)  # [B,S_loc,H,D]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, mesh, axis_name: str = "sp",
+                   causal: bool = True, positions=None):
+    """Sequence-parallel attention: shard_map manual over `axis_name` only;
+    batch/head axes stay under the automatic (GSPMD) partitioner."""
+    from jax import shard_map
+
+    if positions is None:
+        positions = jnp.arange(q.shape[1])
+    spec = P(None, axis_name, None, None)
+    body = functools.partial(ring_attention_manual, axis_name=axis_name,
+                             causal=causal)
+    return shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec, P(axis_name)),
+        out_specs=spec, axis_names={axis_name}, check_vma=False,
+    )(q, k, v, positions)
+
+
+def attention(q, k, v, *, causal: bool = True, mesh=None,
+              sp_axis: str = "sp", positions=None, manual_sp: bool = False):
+    """Dispatch:
+    - `manual_sp=True`: already inside a shard_map manual over `sp_axis`
+      (e.g. a pipeline stage) — run the ring body directly.
+    - mesh shards the sequence axis — wrap in shard_map ring.
+    - otherwise plain attention.
+    """
+    if manual_sp:
+        if positions is None:
+            # A local arange would give every sp rank positions 0..S_loc-1
+            # and a silently wrong causal mask; derive the global block
+            # positions from the rank instead.
+            rank = jax.lax.axis_index(sp_axis)
+            positions = rank * q.shape[1] + jnp.arange(q.shape[1])
+        return ring_attention_manual(q, k, v, positions, axis_name=sp_axis,
+                                     causal=causal)
+    if mesh is not None and sp_axis in mesh.axis_names \
+            and mesh.shape[sp_axis] > 1:
+        return ring_attention(q, k, v, mesh=mesh, axis_name=sp_axis,
+                              causal=causal, positions=positions)
+    return plain_attention(q, k, v, causal=causal, positions=positions)

@@ -1,0 +1,83 @@
+"""Mixture-of-experts with dense (einsum) dispatch — the GShard/Switch
+pattern, which XLA lowers to all-to-alls when the expert axis is sharded.
+
+Expert parallelism (SURVEY.md §2.5 — absent from the reference): expert
+weights carry a leading ``E`` dimension sharded over the ``dp`` mesh axis
+(ep_size == dp_size); the dispatch/combine einsums below then induce the
+token all-to-all automatically under the SPMD partitioner.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def top_k_gating(logits: jax.Array, k: int, capacity: int
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Compute dispatch/combine tensors.
+
+    logits: [T, E] router outputs. Returns (dispatch [T,E,C] bool-ish,
+    combine [T,E,C] float, aux_loss scalar).
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # Load-balancing auxiliary loss (Switch Transformer eq. 4).
+    top1 = jnp.argmax(probs, axis=-1)
+    density = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux_loss = e * jnp.sum(density * density_proxy)
+
+    remaining = probs
+    # Track per-expert fill across the k choices so capacity is shared.
+    fill = jnp.zeros((e,), jnp.int32)
+    gates = []
+    masks = []
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)                  # [T]
+        mask = jax.nn.one_hot(idx, e, dtype=jnp.float32)      # [T,E]
+        gate = jnp.sum(probs * mask, axis=-1)                 # [T]
+        # Position of each token within its chosen expert's buffer.
+        pos_in_expert = (jnp.cumsum(mask, axis=0) - mask) + fill[None, :]
+        pos = jnp.sum(pos_in_expert * mask, axis=-1)          # [T]
+        keep = (pos < capacity) & (gate > 0)
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                dtype=jnp.float32)  # [T,C]
+        sel = mask * keep[:, None]                            # [T,E]
+        gates.append(gate * keep)
+        masks.append(sel[:, :, None] * pos_oh[:, None, :])    # [T,E,C]
+        fill = fill + jnp.sum(sel, axis=0).astype(jnp.int32)
+        remaining = remaining * (1.0 - mask)
+
+    dispatch = sum(masks)
+    denom = sum(gates)
+    denom = jnp.where(denom > 0, denom, 1.0)
+    combine = sum((gate / denom)[:, None, None] * m
+                  for gate, m in zip(gates, masks))
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn(x: jax.Array, router_w: jax.Array, w1: jax.Array, w2: jax.Array,
+            *, top_k: int = 2, capacity_factor: float = 1.25,
+            activation=jax.nn.gelu) -> Tuple[jax.Array, jax.Array]:
+    """MoE feed-forward. x: [B,S,D]; router_w: [D,E]; w1: [E,D,F];
+    w2: [E,F,D]. Returns (y [B,S,D], aux_loss)."""
+    b, s, d = x.shape
+    e = router_w.shape[-1]
+    tokens = x.reshape(b * s, d)
+    capacity = max(int(capacity_factor * (b * s) * top_k / e), top_k)
+
+    logits = jnp.einsum("td,de->te", tokens.astype(jnp.float32),
+                        router_w.astype(jnp.float32))
+    dispatch, combine, aux = top_k_gating(logits, top_k, capacity)
+
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens)
+    h = activation(jnp.einsum("ecd,edf->ecf", expert_in, w1.astype(x.dtype)))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w2.astype(x.dtype))
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return y.reshape(b, s, d), aux

@@ -1,0 +1,28 @@
+"""Rotary position embeddings (RoPE)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rotary_freqs(head_dim: int, max_len: int, theta: float = 10000.0):
+    """Precompute cos/sin tables: [max_len, head_dim//2] each."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                           / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    ang = jnp.outer(t, inv)  # [L, D/2]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rotary(x, cos, sin, positions=None):
+    """x: [..., S, H, D]; cos/sin: [L, D/2]; positions: [S] global indices
+    (defaults to arange — pass explicit global positions under sequence
+    sharding)."""
+    seq = x.shape[-3]
+    if positions is None:
+        positions = jnp.arange(seq)
+    c = cos[positions][:, None, :]  # [S, 1, D/2]
+    s = sin[positions][:, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.astype(x.dtype)

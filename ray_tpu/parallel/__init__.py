@@ -1,0 +1,17 @@
+"""Tensor plane: device meshes, shardings, collectives, TPU topology.
+
+This subsystem replaces the reference's NCCL/GLOO collective layer
+(`python/ray/util/collective/`) and torch.distributed seam
+(`python/ray/train/torch/config.py:106`) with XLA/ICI-native equivalents:
+meshes + NamedSharding for in-graph collectives, `jax.distributed` bootstrap
+for multi-host, and a collective API for out-of-graph control-plane ops.
+"""
+
+from ray_tpu.parallel.mesh import (
+    MeshSpec,
+    auto_mesh,
+    make_mesh,
+    mesh_shape_for,
+)
+
+__all__ = ["MeshSpec", "auto_mesh", "make_mesh", "mesh_shape_for"]

@@ -1,0 +1,97 @@
+"""Device-mesh construction for the canonical parallelism axes.
+
+The framework's standard mesh axes (SURVEY.md §2.5, §7.6):
+
+- ``dp``  — data parallel; also the FSDP/ZeRO shard axis (params sharded over
+  ``dp``; XLA's SPMD partitioner generates the reduce-scatter/all-gather
+  pattern automatically) and the expert-parallel axis (experts sharded over
+  ``dp``, tokens all-to-all'd — the common ep_size == dp_size configuration).
+- ``pp``  — pipeline stages (gpipe schedule via shard_map + ppermute).
+- ``sp``  — sequence/context parallel (ring attention over ICI neighbors).
+- ``tp``  — tensor parallel (Megatron-style row/col sharding).
+
+On real hardware the mesh should follow the physical topology
+(`jax.experimental.mesh_utils.create_device_mesh` does this); on CPU test
+backends we reshape the flat device list.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+AXES = ("dp", "pp", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape; -1 on dp means 'absorb remaining devices'."""
+
+    dp: int = -1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> Tuple[int, int, int, int]:
+        fixed = self.pp * self.sp * self.tp
+        dp = self.dp
+        if dp == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by pp*sp*tp={fixed}")
+            dp = n_devices // fixed
+        if dp * fixed != n_devices:
+            raise ValueError(
+                f"Mesh {dp}x{self.pp}x{self.sp}x{self.tp} != {n_devices} devices")
+        return (dp, self.pp, self.sp, self.tp)
+
+
+def mesh_shape_for(n_devices: int) -> Tuple[int, int, int, int]:
+    """Factorize n devices over (dp, pp, sp, tp), spreading across as many
+    axes as possible so every parallelism mode is exercised: factors are dealt
+    to tp, pp, sp, then dp absorbs the rest."""
+    remaining = n_devices
+    shape = {"dp": 1, "pp": 1, "sp": 1, "tp": 1}
+    for axis in ("tp", "pp", "sp"):
+        if remaining % 2 == 0 and remaining > 1:
+            shape[axis] *= 2
+            remaining //= 2
+    shape["dp"] = remaining
+    return (shape["dp"], shape["pp"], shape["sp"], shape["tp"])
+
+
+def make_mesh(shape: Optional[Sequence[int]] = None,
+              *, devices=None, axis_names: Sequence[str] = AXES):
+    """Build a `jax.sharding.Mesh` with the canonical axis names."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if shape is None:
+        shape = mesh_shape_for(n)
+    shape = tuple(shape)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    try:
+        from jax.experimental import mesh_utils
+        arr = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        arr = np.array(devices).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def auto_mesh(n_devices: Optional[int] = None, **axis_sizes):
+    """`auto_mesh(8)` or `auto_mesh(dp=2, tp=4)`."""
+    import jax
+
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    if axis_sizes:
+        spec = MeshSpec(**axis_sizes)
+        return make_mesh(spec.resolve(len(devices)), devices=devices)
+    return make_mesh(devices=devices)

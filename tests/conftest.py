@@ -19,6 +19,17 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _jax_on_cpu():
+    """Pin the default device to CPU for the whole test session: the real
+    TPU (when attached) computes matmuls in bf16 by default, which breaks
+    exact-comparison tests. TPU-specific tests opt back in explicitly."""
+    import jax
+
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    yield
+
+
 @pytest.fixture
 def ray_start_local():
     """Local-mode runtime (reference fixture analog: ray_start_regular)."""

@@ -1,0 +1,108 @@
+"""GPipe pipeline parallelism: matches non-pipelined, trains, composes with
+sp (ring attention in the same manual shard_map) + tp + MoE-EP."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.models import TransformerConfig, forward, init_params
+from ray_tpu.models.transformer import (
+    forward_pipelined,
+    lm_loss_pipelined,
+    pipelined_param_specs,
+    to_pipelined,
+)
+from ray_tpu.parallel import make_mesh
+from ray_tpu.parallel.spmd import batch_sharding, make_train_step, shard_pytree
+
+CFG = TransformerConfig(
+    vocab_size=128, d_model=64, n_layers=4, n_heads=4, d_ff=128,
+    max_seq_len=64, dtype=jnp.float32)
+
+
+def _tokens(key, b=8, s=32, vocab=128):
+    return jax.random.randint(key, (b, s), 0, vocab, jnp.int32)
+
+
+def test_pipelined_matches_plain():
+    mesh = make_mesh((2, 2, 1, 2), devices=jax.devices("cpu")[:8])
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    toks = _tokens(jax.random.PRNGKey(1))
+
+    ref, _ = forward(params, toks, CFG)
+
+    pp_params = shard_pytree(to_pipelined(params, 2),
+                             pipelined_param_specs(CFG), mesh)
+    toks_s = jax.device_put(toks, NamedSharding(mesh, P("dp", None)))
+    out, _ = jax.jit(lambda p, t: forward_pipelined(
+        p, t, CFG, mesh, num_microbatches=4))(pp_params, toks_s)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-5, atol=5e-5)
+
+
+def test_pipelined_with_sp_matches_plain():
+    """pp=2 and sp=2 in one manual shard_map: ring attention inside stages."""
+    mesh = make_mesh((1, 2, 2, 2), devices=jax.devices("cpu")[:8])
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    toks = _tokens(jax.random.PRNGKey(1))
+
+    ref, _ = forward(params, toks, CFG)
+
+    pp_params = shard_pytree(to_pipelined(params, 2),
+                             pipelined_param_specs(CFG), mesh)
+    toks_s = jax.device_put(toks, NamedSharding(mesh, P("dp", None)))
+    out, _ = jax.jit(lambda p, t: forward_pipelined(
+        p, t, CFG, mesh, num_microbatches=2))(pp_params, toks_s)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-5, atol=5e-5)
+
+
+def test_full_4d_training_step():
+    """dp x pp x sp x tp all >1... as far as 8 devices allow: (1,2,2,2) with
+    MoE experts over dp — every parallelism mode in one jitted train step."""
+    import optax
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=4, n_heads=4, d_ff=64,
+        num_experts=2, max_seq_len=32, dtype=jnp.float32)
+    mesh = make_mesh((1, 2, 2, 2), devices=jax.devices("cpu")[:8])
+    params = shard_pytree(
+        to_pipelined(init_params(jax.random.PRNGKey(0), cfg), 2),
+        pipelined_param_specs(cfg), mesh)
+    optimizer = optax.adamw(3e-3)
+    opt_state = jax.jit(optimizer.init)(params)
+    toks = _tokens(jax.random.PRNGKey(3), b=8, s=17, vocab=64)
+    batch = {"tokens": jax.device_put(toks, batch_sharding(mesh))}
+
+    step = make_train_step(
+        lambda p, b: lm_loss_pipelined(p, b, cfg, mesh, num_microbatches=2),
+        optimizer)
+    losses = []
+    p, o = params, opt_state
+    for _ in range(8):
+        p, o, loss = step(p, o, batch)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_pipelined_aux_matches_plain():
+    """MoE aux loss must not scale with num_microbatches (objective parity)."""
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=4, n_heads=4, d_ff=64,
+        num_experts=2, max_seq_len=32, dtype=jnp.float32)
+    mesh = make_mesh((2, 2, 1, 2), devices=jax.devices("cpu")[:8])
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    toks = _tokens(jax.random.PRNGKey(1), b=8, s=32, vocab=64)
+
+    _, aux_ref = forward(params, toks, cfg)
+    pp_params = shard_pytree(to_pipelined(params, 2),
+                             pipelined_param_specs(cfg), mesh)
+    toks_s = jax.device_put(toks, NamedSharding(mesh, P("dp", None)))
+    for m in (2, 4):
+        _, aux_pp = jax.jit(lambda p, t, m=m: forward_pipelined(
+            p, t, cfg, mesh, num_microbatches=m))(pp_params, toks_s)
+        np.testing.assert_allclose(float(aux_pp), float(aux_ref),
+                                   rtol=0.2), (m, float(aux_pp), float(aux_ref))

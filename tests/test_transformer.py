@@ -1,0 +1,108 @@
+"""Flagship transformer: sharded == unsharded, training works, MoE works."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import TransformerConfig, forward, init_params, param_specs
+
+CFG = TransformerConfig(
+    vocab_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+    max_seq_len=64, dtype=jnp.float32)
+
+
+def _tokens(key, b=4, s=32, vocab=128):
+    return jax.random.randint(key, (b, s), 0, vocab, jnp.int32)
+
+
+def test_forward_shapes():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    toks = _tokens(jax.random.PRNGKey(1))
+    logits, aux = forward(params, toks, CFG)
+    assert logits.shape == (4, 32, 128)
+    assert jnp.isfinite(logits).all()
+
+
+def test_sharded_matches_unsharded():
+    """The same forward under a (dp,sp,tp) mesh with FSDP/TP/ring-SP sharding
+    must agree with single-device execution."""
+    from ray_tpu.parallel import make_mesh
+    from ray_tpu.parallel.spmd import shard_pytree
+
+    mesh = make_mesh((2, 1, 2, 2), devices=jax.devices("cpu")[:8])
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    toks = _tokens(jax.random.PRNGKey(1))
+
+    ref, _ = forward(params, toks, CFG)
+
+    sp = shard_pytree(params, param_specs(CFG), mesh)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    toks_s = jax.device_put(toks, NamedSharding(mesh, P("dp", "sp")))
+    out, _ = jax.jit(
+        lambda p, t: forward(p, t, CFG, mesh=mesh))(sp, toks_s)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-5, atol=5e-5)
+
+
+def test_moe_forward_and_aux():
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+        num_experts=4, max_seq_len=64, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    toks = _tokens(jax.random.PRNGKey(1))
+    logits, aux = forward(params, toks, cfg)
+    assert logits.shape == (4, 32, 128)
+    assert jnp.isfinite(logits).all()
+    assert aux > 0  # load-balancing loss active
+
+
+def test_overfit_tiny_batch():
+    """Loss must drop sharply when memorizing one batch (end-to-end grads)."""
+    import optax
+    from ray_tpu.models.transformer import lm_loss
+    from ray_tpu.parallel.spmd import make_train_step
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    optimizer = optax.adam(1e-2)
+    opt_state = optimizer.init(params)
+    batch = {"tokens": _tokens(jax.random.PRNGKey(2), b=2, s=17, vocab=64)}
+
+    step = make_train_step(lambda p, b: lm_loss(p, b, cfg), optimizer)
+    losses = []
+    for _ in range(40):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+def test_sharded_training_step_runs():
+    """Full sharded train step on the 8-device CPU mesh (dp/sp/tp + MoE-EP)."""
+    import optax
+    from ray_tpu.models.transformer import lm_loss
+    from ray_tpu.parallel import make_mesh
+    from ray_tpu.parallel.spmd import (batch_sharding, init_sharded,
+                                       make_train_step)
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        num_experts=4, max_seq_len=32, dtype=jnp.float32)
+    mesh = make_mesh((2, 1, 2, 2), devices=jax.devices("cpu")[:8])
+    params = init_sharded(
+        lambda k: init_params(k, cfg), param_specs(cfg), mesh,
+        jax.random.PRNGKey(0))
+    optimizer = optax.adamw(1e-3)
+    opt_state = jax.jit(optimizer.init)(params)
+    toks = _tokens(jax.random.PRNGKey(3), b=4, s=17, vocab=64)
+    batch = {"tokens": jax.device_put(
+        toks, batch_sharding(mesh))}
+
+    step = make_train_step(lambda p, b: lm_loss(p, b, cfg, mesh=mesh),
+                           optimizer)
+    p1, o1, loss1 = step(params, opt_state, batch)
+    p2, _, loss2 = step(p1, o1, batch)
+    assert jnp.isfinite(loss1) and jnp.isfinite(loss2)
+    assert float(loss2) < float(loss1)
