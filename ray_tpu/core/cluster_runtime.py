@@ -1,0 +1,1124 @@
+"""The distributed core-worker runtime, used by drivers AND workers.
+
+Reference equivalent: `src/ray/core_worker/` — one library linked into every
+process (`core_worker.h`): task submission over leased workers
+(`direct_task_transport.cc`), direct actor transport, ownership + in-process
+memory store (`memory_store.h`), plasma provider, and the owner-side object
+directory (`ownership_based_object_directory.h`).
+
+Call stack parity with SURVEY.md §3.2: submit_task -> lease from raylet
+(spillback honored) -> push_task direct to the leased worker -> returns
+inline (small) or sealed into the node store (large) -> owner records
+locations; `get` merges the memory store and shm store and pulls remote
+copies through the local raylet.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import ray_config
+from ray_tpu.core.function_manager import FunctionManager
+from ray_tpu.core.gcs.client import GcsClient
+from ray_tpu.core.generator import ObjectRefGenerator
+from ray_tpu.core.ids import (ActorID, JobID, NodeID, ObjectID, TaskID,
+                              WorkerID, _Counter)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_store import WorkerStoreClient, _WriteIntoShm
+from ray_tpu.core.rpc import (ConnectionLost, EventLoopThread, RpcClient,
+                              RpcError, RpcServer, ServerConnection)
+from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
+                                GetTimeoutError, ObjectLostError,
+                                RayActorError, RayTaskError,
+                                TaskCancelledError)
+
+logger = logging.getLogger(__name__)
+
+INLINE_LIMIT_KEY = "max_direct_call_object_size"
+
+
+class _Owned:
+    """Owner-side record of one object (reference: reference_count.h entry +
+    memory-store slot)."""
+
+    __slots__ = ("fut", "nodes", "refcount", "is_stored")
+
+    def __init__(self):
+        self.fut: concurrent.futures.Future = concurrent.futures.Future()
+        self.nodes: List[str] = []
+        self.refcount = 0
+        self.is_stored = False  # True once sealed into a node store
+
+
+class _ActorState:
+    def __init__(self, actor_id_hex: str):
+        self.actor_id_hex = actor_id_hex
+        self.address: Optional[str] = None
+        self.state = "PENDING"
+        self.client: Optional[RpcClient] = None
+        self.restarts_remaining = 0
+        self.creation: Optional[dict] = None  # for owner-led restart
+        self.lock = None  # asyncio.Lock, created lazily on the loop
+        self.alive_event: Optional[object] = None
+
+
+class _LeasePool:
+    """Per-scheduling-key worker leases (reference: direct_task_transport
+    SchedulingKey entries + pipelined lease requests)."""
+
+    def __init__(self):
+        self.idle: List[dict] = []
+        self.inflight_leases = 0
+        self.queue: List[Any] = []  # pending (spec, opts, reply_future)
+
+
+class ClusterRuntime:
+    is_local_mode = False
+
+    # ==================================================================
+    # construction
+    # ==================================================================
+    def __init__(self, *, gcs_address: str, raylet_address: str,
+                 mode: str = "driver", worker_id: Optional[str] = None,
+                 node_id: Optional[str] = None,
+                 namespace: Optional[str] = None, node=None):
+        self.mode = mode
+        self.namespace = namespace or "default"
+        self.gcs_address = gcs_address
+        self.raylet_address = raylet_address
+        self.job_id = JobID.from_int(os.getpid() % 2**31)
+        self.worker_id = (WorkerID(bytes.fromhex(worker_id))
+                          if worker_id and len(worker_id) == 56
+                          else WorkerID.from_random())
+        self.node_id = (NodeID(bytes.fromhex(node_id))
+                        if node_id else None)
+        self._node = node  # owned process supervisor (head driver only)
+
+        self._loop = EventLoopThread(name=f"{mode}-rpc")
+        self._gcs = GcsClient(gcs_address)
+        self._raylet = RpcClient(raylet_address)
+        self._server = RpcServer(self)
+        self._loop.run(self._async_start())
+
+        self._shm = WorkerStoreClient()
+        self._owned: Dict[str, _Owned] = {}
+        self._owned_lock = threading.Lock()
+        self._generators: Dict[str, ObjectRefGenerator] = {}
+        self._put_counter = _Counter()
+        self._lease_pools: Dict[str, _LeasePool] = {}
+        self._raylet_clients: Dict[str, RpcClient] = {self.raylet_address:
+                                                      self._raylet}
+        self._actors: Dict[str, _ActorState] = {}
+        self._actor_meta: Dict[str, Tuple[str, dict]] = {}
+        self._fn = FunctionManager(
+            kv_put=lambda k, v, ow: self._loop.run(
+                self._gcs.kv_put(k, v, ow)),
+            kv_get=lambda k: self._loop.run(self._gcs.kv_get(k)))
+
+        # worker-mode execution state
+        self._exec_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="task-exec")
+        self._actor_instance: Any = None
+        self._actor_executor: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
+        self._actor_loop = None
+        self._actor_id_hex: Optional[str] = None
+        self._shutdown = False
+
+        if mode == "driver":
+            self._loop.run(self._gcs.add_job(self.job_id.hex(), {
+                "driver_pid": os.getpid(), "namespace": self.namespace}))
+
+    async def _async_start(self) -> None:
+        await self._server.start()
+        await self._gcs.connect()
+        await self._raylet.connect()
+        self.address = self._server.address
+
+    # -- bring-up helpers ----------------------------------------------
+    @classmethod
+    def connect_or_start(cls, address: Optional[str] = None,
+                         num_cpus: Optional[int] = None,
+                         num_gpus: Optional[int] = None,
+                         resources: Optional[dict] = None,
+                         namespace: Optional[str] = None,
+                         object_store_memory: Optional[int] = None,
+                         **_: Any) -> "ClusterRuntime":
+        from ray_tpu.core.node import NodeSupervisor
+
+        if address in (None, "local"):
+            node = NodeSupervisor.start_head(
+                num_cpus=num_cpus, num_gpus=num_gpus, resources=resources,
+                object_store_memory=object_store_memory)
+            return cls(gcs_address=node.gcs_address,
+                       raylet_address=node.raylet_address,
+                       namespace=namespace, node=node,
+                       node_id=node.node_id)
+        if address.startswith("ray://"):
+            address = address[len("ray://"):]
+        # Connect to an existing cluster: find this machine's raylet (or the
+        # head raylet) from the GCS node table.
+        probe = GcsClient(address)
+        loop = EventLoopThread(name="probe")
+        try:
+            loop.run(probe.connect())
+            nodes = loop.run(probe.get_nodes())
+            loop.run(probe.close())
+        finally:
+            loop.stop()
+        alive = [n for n in nodes if n.get("alive")]
+        if not alive:
+            raise ConnectionError(f"no alive nodes at GCS {address}")
+        head = next((n for n in alive if n.get("is_head")), alive[0])
+        return cls(gcs_address=address, raylet_address=head["address"],
+                   namespace=namespace, node_id=head["node_id"])
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        try:
+            if self.mode == "driver":
+                self._loop.run(self._gcs.mark_job_finished(
+                    self.job_id.hex()), timeout=2)
+        except Exception:
+            pass
+        try:
+            self._loop.run(self._server.stop(), timeout=2)
+        except Exception:
+            pass
+        self._shm.close()
+        self._exec_pool.shutdown(wait=False, cancel_futures=True)
+        if self._node is not None:
+            self._node.stop()
+        self._loop.stop()
+
+    # ==================================================================
+    # ownership / reference counting
+    # ==================================================================
+    def _owned_entry(self, oid_hex: str) -> _Owned:
+        with self._owned_lock:
+            entry = self._owned.get(oid_hex)
+            if entry is None:
+                entry = _Owned()
+                self._owned[oid_hex] = entry
+            return entry
+
+    def add_local_reference(self, object_id: ObjectID) -> None:
+        with self._owned_lock:
+            entry = self._owned.get(object_id.hex())
+            if entry is not None:
+                entry.refcount += 1
+
+    def remove_local_reference(self, object_id: ObjectID) -> None:
+        if self._shutdown:
+            return
+        oid = object_id.hex()
+        with self._owned_lock:
+            entry = self._owned.get(oid)
+            if entry is None:
+                return
+            entry.refcount -= 1
+            if entry.refcount > 0 or not entry.fut.done():
+                return
+            del self._owned[oid]
+            nodes = list(entry.nodes)
+        if nodes:
+            async def _delete():
+                for addr in nodes:
+                    try:
+                        client = await self._raylet_client(addr)
+                        await client.call("delete_objects", oids=[oid],
+                                          timeout=5.0)
+                    except Exception:
+                        pass
+            self._loop.spawn(_delete())
+
+    def on_ref_deserialized(self, ref: ObjectRef) -> None:
+        with self._owned_lock:
+            entry = self._owned.get(ref.hex())
+            if entry is not None:
+                entry.refcount += 1
+
+    # ==================================================================
+    # objects: put / get / wait
+    # ==================================================================
+    def put(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("Calling put() on an ObjectRef is not allowed.")
+        task_id = TaskID.for_task(self.job_id)
+        object_id = ObjectID.for_put(task_id, self._put_counter.next())
+        oid = object_id.hex()
+        so = serialization.serialize(value)
+        entry = self._owned_entry(oid)
+        self._store_serialized(oid, so, entry)
+        return ObjectRef(object_id, owner=self.address, runtime=self)
+
+    def _store_serialized(self, oid: str, so, entry: _Owned) -> None:
+        size = so.total_size()
+        if size <= ray_config().max_direct_call_object_size:
+            entry.fut.set_result(("inline", so.to_bytes()))
+            return
+        shm_name = self._loop.run(
+            self._raylet.call("create_object", oid=oid, size=size))
+
+        def write(buf):
+            so.write_into(_WriteIntoShm(buf))
+
+        self._shm.write(shm_name, write)
+        self._loop.run(self._raylet.call("seal_object", oid=oid))
+        entry.nodes.append(self.raylet_address)
+        entry.is_stored = True
+        entry.fut.set_result(("node", self.raylet_address))
+
+    def _deserialize_payload(self, data) -> Any:
+        return serialization.deserialize(data)
+
+    def _read_local_shm(self, info: dict) -> Any:
+        view = self._shm.read(info["shm_name"], info["size"])
+        return self._deserialize_payload(view)
+
+    def _fetch(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
+        """Blocking fetch of one object's value."""
+        oid = ref.hex()
+        entry = None
+        with self._owned_lock:
+            entry = self._owned.get(oid)
+        if entry is not None:
+            try:
+                kind, payload = entry.fut.result(timeout=timeout)
+            except concurrent.futures.TimeoutError:
+                raise GetTimeoutError(f"timed out waiting for {ref}")
+            if kind == "inline":
+                return self._deserialize_payload(payload)
+            # stored on some node; pull through the local raylet
+            res = self._loop.run(self._raylet.call(
+                "pull_object", oid=oid, owner_address=self.address,
+                timeout=None), timeout=timeout)
+        else:
+            owner = ref.owner_address
+            res = self._loop.run(self._raylet.call(
+                "pull_object", oid=oid,
+                owner_address=owner.decode() if isinstance(owner, bytes)
+                else owner, timeout=None), timeout=timeout)
+        if res is None:
+            raise ObjectLostError(oid)
+        if res.get("error"):
+            if "timeout" in res["error"]:
+                raise GetTimeoutError(f"timed out fetching {ref}: "
+                                      f"{res['error']}")
+            raise ObjectLostError(oid)
+        if "inline" in res and res["inline"] is not None:
+            return self._deserialize_payload(res["inline"])
+        return self._read_local_shm(res)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, (ObjectRef, ObjectRefGenerator))
+        if not single and not hasattr(refs, "__iter__"):
+            raise TypeError(
+                "get() expects an ObjectRef or a list of ObjectRefs, got "
+                f"{type(refs).__name__}")
+        ref_list = [refs] if single else list(refs)
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        out = []
+        for ref in ref_list:
+            if isinstance(ref, ObjectRefGenerator):
+                raise TypeError(
+                    "Cannot get() an ObjectRefGenerator; iterate it.")
+            if not isinstance(ref, ObjectRef):
+                raise TypeError(
+                    f"get() expects ObjectRef(s), got {type(ref).__name__}")
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            out.append(self._fetch(ref, remaining))
+        return out[0] if single else out
+
+    def _is_ready(self, ref: ObjectRef) -> bool:
+        oid = ref.hex()
+        with self._owned_lock:
+            entry = self._owned.get(oid)
+        if entry is not None:
+            return entry.fut.done()
+        owner = ref.owner_address
+        owner = owner.decode() if isinstance(owner, bytes) else owner
+        try:
+            loc = self._loop.run(self._ask_owner_locations(owner, oid),
+                                 timeout=10)
+        except Exception:
+            return False
+        return loc is not None and not loc.get("pending")
+
+    async def _ask_owner_locations(self, owner_addr: str, oid: str):
+        client = await self._worker_client(owner_addr)
+        return await client.call("get_object_locations", oid=oid,
+                                 timeout=10.0)
+
+    def wait(self, refs, num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True):
+        if isinstance(refs, ObjectRef):
+            raise TypeError("wait() expects a list of ObjectRefs")
+        refs = list(refs)
+        if len(set(refs)) != len(refs):
+            raise ValueError("wait() got duplicate ObjectRefs")
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds the number of refs")
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        ready: List[ObjectRef] = []
+        pending = list(refs)
+        while len(ready) < num_returns:
+            for ref in list(pending):
+                if self._is_ready(ref):
+                    ready.append(ref)
+                    pending.remove(ref)
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.005)
+        return ready, pending
+
+    # ==================================================================
+    # task submission (reference: direct_task_transport.cc)
+    # ==================================================================
+    def submit_task(self, remote_function, opts, args, kwargs):
+        from ray_tpu.core.options import resource_demand
+
+        task_id = TaskID.for_task(self.job_id)
+        fn_key = self._fn.export(remote_function._function)
+        streaming = opts.num_returns in ("streaming", "dynamic")
+        num_returns = 1 if streaming else opts.num_returns
+        args_blob = serialization.serialize((args, kwargs)).to_bytes()
+        spec = {
+            "task_id": task_id.hex(),
+            "fn_key": fn_key,
+            "name": remote_function._function_name,
+            "args": args_blob,
+            "num_returns": num_returns,
+            "streaming": streaming,
+            "owner": self.address,
+            "resources": resource_demand(opts),
+            "max_retries": opts.max_retries,
+        }
+        refs = [ObjectRef(ObjectID.for_return(task_id, i + 1),
+                          owner=self.address, runtime=self)
+                for i in range(max(num_returns, 1))]
+        for r in refs:
+            self._owned_entry(r.hex())
+        gen = None
+        if streaming:
+            gen = ObjectRefGenerator()
+            self._generators[task_id.hex()] = gen
+        self._loop.spawn(self._submit_async(spec, refs))
+        if streaming:
+            return gen
+        if opts.num_returns == 0:
+            return None
+        return refs[0] if opts.num_returns == 1 else refs
+
+    async def _submit_async(self, spec: dict, refs: List[ObjectRef]) -> None:
+        retries = spec.get("max_retries", 0)
+        attempt = 0
+        while True:
+            try:
+                await self._run_on_leased_worker(spec)
+                return
+            except (ConnectionLost, RpcError) as e:
+                attempt += 1
+                if attempt > max(retries, 0):
+                    self._fail_task(spec, refs,
+                                    f"worker died ({e}); retries exhausted")
+                    return
+                logger.info("retrying task %s (attempt %d): %s",
+                            spec["name"], attempt, e)
+                delay = ray_config().task_retry_delay_ms / 1000.0
+                if delay:
+                    import asyncio
+                    await asyncio.sleep(delay)
+            except Exception as e:  # noqa: BLE001
+                self._fail_task(spec, refs, f"submission failed: {e}")
+                return
+
+    def _fail_task(self, spec: dict, refs: List[ObjectRef],
+                   message: str) -> None:
+        from ray_tpu.exceptions import WorkerCrashedError
+        err = serialization.serialize_error(
+            WorkerCrashedError(f"task {spec['name']}: {message}"))
+        blob = err.to_bytes()
+        for r in refs:
+            entry = self._owned_entry(r.hex())
+            if not entry.fut.done():
+                entry.fut.set_result(("inline", blob))
+        gen = self._generators.pop(spec["task_id"], None)
+        if gen is not None:
+            from ray_tpu.exceptions import WorkerCrashedError as WCE
+            gen._finish(WCE(f"task {spec['name']}: {message}"))
+
+    async def _run_on_leased_worker(self, spec: dict) -> None:
+        key = f"{spec['fn_key']}:{sorted(spec['resources'].items())}"
+        worker = await self._acquire_worker(key, spec["resources"])
+        try:
+            client = await self._worker_client(worker["worker_address"])
+            reply = await client.call("push_task", spec=spec, timeout=None)
+        except Exception:
+            await self._return_worker(worker, dead=True)
+            raise
+        self._record_task_reply(spec, reply)
+        await self._release_worker(key, worker)
+
+    def _record_task_reply(self, spec: dict, reply: dict) -> None:
+        task_id = spec["task_id"]
+        for res in reply.get("results", []):
+            entry = self._owned_entry(res["oid"])
+            if res.get("node"):
+                entry.nodes.append(res["node"])
+                entry.is_stored = True
+                if not entry.fut.done():
+                    entry.fut.set_result(("node", res["node"]))
+            else:
+                if not entry.fut.done():
+                    entry.fut.set_result(("inline", res["inline"]))
+        if spec.get("streaming") and reply.get("done"):
+            gen = self._generators.pop(task_id, None)
+            if gen is not None:
+                err = reply.get("error_blob")
+                if err is not None:
+                    try:
+                        self._deserialize_payload(err)
+                        exc = None
+                    except BaseException as e:  # noqa: BLE001
+                        exc = e
+                    gen._finish(exc)
+                else:
+                    gen._finish()
+
+    # -- lease pool ----------------------------------------------------
+    async def _acquire_worker(self, key: str,
+                              resources: Dict[str, float]) -> dict:
+        pool = self._lease_pools.setdefault(key, _LeasePool())
+        if pool.idle:
+            return pool.idle.pop()
+        return await self._request_lease(resources)
+
+    async def _request_lease(self, resources: Dict[str, float],
+                             is_actor: bool = False) -> dict:
+        address = self.raylet_address
+        spillbacks = 0
+        while True:
+            client = await self._raylet_client(address)
+            reply = await client.call(
+                "request_worker_lease", resources=resources,
+                is_actor=is_actor, spillback_count=spillbacks,
+                timeout=ray_config().worker_lease_timeout_ms / 1000.0)
+            if reply.get("granted"):
+                info = reply["granted"]
+                info["raylet_address"] = address
+                return info
+            if reply.get("spillback"):
+                address = reply["spillback"]
+                spillbacks += 1
+                continue
+            raise RpcError(f"lease failed: {reply}")
+
+    async def _release_worker(self, key: str, worker: dict) -> None:
+        pool = self._lease_pools.setdefault(key, _LeasePool())
+        # Keep the lease for reuse; return it if nothing else is queued.
+        pool.idle.append(worker)
+        import asyncio
+        await asyncio.sleep(0.05)
+        if worker in pool.idle:
+            pool.idle.remove(worker)
+            await self._return_worker(worker)
+
+    async def _return_worker(self, worker: dict, dead: bool = False) -> None:
+        try:
+            client = await self._raylet_client(worker["raylet_address"])
+            await client.call("return_worker", lease_id=worker["lease_id"],
+                              worker_id=worker["worker_id"],
+                              resources=worker.get("resources", {}),
+                              dead=dead, timeout=5.0)
+        except Exception:
+            pass
+
+    # -- clients -------------------------------------------------------
+    async def _raylet_client(self, address: str) -> RpcClient:
+        client = self._raylet_clients.get(address)
+        if client is None or not client.connected:
+            client = RpcClient(address)
+            await client.connect(timeout=10.0)
+            self._raylet_clients[address] = client
+        return client
+
+    _worker_client_cache: Dict[str, RpcClient]
+
+    async def _worker_client(self, address: str) -> RpcClient:
+        cache = getattr(self, "_worker_clients", None)
+        if cache is None:
+            cache = self._worker_clients = {}
+        client = cache.get(address)
+        if client is None or not client.connected:
+            client = RpcClient(address)
+            await client.connect(timeout=10.0)
+            cache[address] = client
+        return client
+
+    # ==================================================================
+    # actors (reference: actor lifecycle gcs_actor_manager.h:251, direct
+    # actor transport; creation here is owner-led)
+    # ==================================================================
+    def create_actor(self, actor_class, opts, args, kwargs):
+        from ray_tpu.core.actor import ActorHandle
+        from ray_tpu.core.options import resource_demand
+
+        actor_id = ActorID.of(self.job_id)
+        aid = actor_id.hex()
+        cls_key = self._fn.export(actor_class._cls)
+        meta = actor_class.method_meta()
+        # Placement needs 1 CPU when nothing is specified; the running actor
+        # then holds only its explicit demand (reference actor defaults).
+        running_demand = resource_demand(opts)
+        demand = running_demand or {"CPU": 1.0}
+        info = {
+            "class_name": actor_class._class_name,
+            "name": opts.name,
+            "namespace": (self.namespace if opts.namespace is None
+                          else opts.namespace),
+            "owner": self.address,
+            "state": "PENDING",
+            "max_restarts": opts.max_restarts,
+            "method_meta": {k: {kk: vv for kk, vv in m.items()}
+                            for k, m in meta.items()},
+        }
+        reply = self._loop.run(self._gcs.register_actor(aid, info))
+        if not reply.get("ok"):
+            raise ValueError(reply.get("error", "actor registration failed"))
+
+        state = _ActorState(aid)
+        state.restarts_remaining = opts.max_restarts
+        args_blob = serialization.serialize((args, kwargs)).to_bytes()
+        state.creation = {
+            "cls_key": cls_key,
+            "args": args_blob,
+            "demand": demand,
+            "release_after_start": {} if running_demand else demand,
+            "max_concurrency": opts.max_concurrency,
+            "class_name": actor_class._class_name,
+        }
+        self._actors[aid] = state
+        self._actor_meta[aid] = (actor_class._class_name, meta)
+        self._loop.run(self._create_actor_async(state))
+        return ActorHandle(actor_id, actor_class._class_name, meta,
+                           runtime=self)
+
+    async def _create_actor_async(self, state: _ActorState) -> None:
+        creation = state.creation
+        worker = await self._request_lease(creation["demand"], is_actor=True)
+        client = await self._worker_client(worker["worker_address"])
+        try:
+            reply = await client.call(
+                "actor_init", actor_id=state.actor_id_hex,
+                cls_key=creation["cls_key"], args=creation["args"],
+                max_concurrency=creation["max_concurrency"],
+                owner=self.address, timeout=120.0)
+        except Exception as e:
+            await self._return_worker(worker, dead=True)
+            await self._gcs.update_actor(state.actor_id_hex, {
+                "state": "DEAD", "death_cause": f"init push failed: {e}"})
+            raise
+        if reply.get("error_blob") is not None:
+            await self._return_worker(worker, dead=False)
+            await self._gcs.update_actor(state.actor_id_hex, {
+                "state": "DEAD", "death_cause": "exception in __init__"})
+            state.state = "DEAD"
+            # Surface the constructor error to the caller now.
+            self._deserialize_payload(reply["error_blob"])
+            return
+        raylet_client = await self._raylet_client(worker["raylet_address"])
+        await raylet_client.call(
+            "mark_actor_worker", worker_id=worker["worker_id"],
+            actor_id=state.actor_id_hex,
+            release=creation.get("release_after_start") or None, timeout=5.0)
+        state.address = worker["worker_address"]
+        state.client = client
+        state.state = "ALIVE"
+        await self._gcs.update_actor(state.actor_id_hex, {
+            "state": "ALIVE", "address": worker["worker_address"],
+            "node_id": worker["node_id"], "worker_id": worker["worker_id"],
+        })
+
+    def submit_actor_task(self, handle, method_name, opts, args, kwargs):
+        aid = handle._ray_actor_id.hex()
+        task_id = TaskID.for_actor_task(handle._ray_actor_id)
+        streaming = opts.num_returns in ("streaming", "dynamic")
+        num_returns = 1 if streaming else opts.num_returns
+        args_blob = serialization.serialize((args, kwargs)).to_bytes()
+        spec = {
+            "task_id": task_id.hex(),
+            "actor_id": aid,
+            "method": method_name,
+            "name": f"{handle._class_name}.{method_name}",
+            "args": args_blob,
+            "num_returns": num_returns,
+            "streaming": streaming,
+            "owner": self.address,
+        }
+        refs = [ObjectRef(ObjectID.for_return(task_id, i + 1),
+                          owner=self.address, runtime=self)
+                for i in range(max(num_returns, 1))]
+        for r in refs:
+            self._owned_entry(r.hex())
+        gen = None
+        if streaming:
+            gen = ObjectRefGenerator()
+            self._generators[task_id.hex()] = gen
+        self._loop.spawn(self._submit_actor_async(spec, refs))
+        if streaming:
+            return gen
+        if opts.num_returns == 0:
+            return None
+        return refs[0] if opts.num_returns == 1 else refs
+
+    async def _actor_client(self, aid: str) -> RpcClient:
+        state = self._actors.get(aid)
+        if state is None or state.address is None or state.state != "ALIVE":
+            # Borrowed handle or restarting actor: resolve via GCS, waiting
+            # briefly for PENDING/RESTARTING actors to come up.
+            import asyncio
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                info = await self._gcs.get_actor(actor_id=aid)
+                if info is None:
+                    raise ActorDiedError(error_msg="unknown actor")
+                if info["state"] == "ALIVE":
+                    if state is None:
+                        state = _ActorState(aid)
+                        self._actors[aid] = state
+                    state.address = info["address"]
+                    state.state = "ALIVE"
+                    break
+                if info["state"] == "DEAD":
+                    raise ActorDiedError(
+                        error_msg=f"actor is dead: "
+                                  f"{info.get('death_cause', 'unknown')}")
+                await asyncio.sleep(0.1)
+            else:
+                raise ActorUnavailableError(
+                    error_msg="timed out waiting for actor to become ALIVE")
+        return await self._worker_client(state.address)
+
+    async def _submit_actor_async(self, spec: dict,
+                                  refs: List[ObjectRef]) -> None:
+        aid = spec["actor_id"]
+        try:
+            client = await self._actor_client(aid)
+            reply = await client.call("push_actor_task", spec=spec,
+                                      timeout=None)
+            self._record_task_reply(spec, reply)
+        except RayActorError as e:
+            self._fail_actor_task(spec, refs, e)
+        except (ConnectionLost, RpcError) as e:
+            # In-flight calls fail when the actor dies (reference semantics:
+            # no implicit replay without max_task_retries); the restart, if
+            # allowed, proceeds in the background for future calls.
+            state = self._actors.get(aid)
+            if state is not None:
+                state.state = "RESTARTING"
+                state.address = None
+                import asyncio
+                asyncio.ensure_future(self._maybe_restart_actor(state))
+            self._fail_actor_task(
+                spec, refs,
+                ActorDiedError(error_msg=f"actor connection lost: {e}"))
+        except Exception as e:  # noqa: BLE001
+            self._fail_actor_task(
+                spec, refs, RayActorError(error_msg=str(e)))
+
+    async def _maybe_restart_actor(self, state: Optional[_ActorState]
+                                   ) -> bool:
+        """Owner-led actor restart (reference: GCS restarts up to
+        max_restarts, gcs_actor_manager.h RESTARTING)."""
+        if (state is None or state.creation is None
+                or state.restarts_remaining == 0):
+            if state is not None and state.creation is not None:
+                await self._gcs.update_actor(state.actor_id_hex, {
+                    "state": "DEAD", "death_cause": "worker died"})
+                state.state = "DEAD"
+            return False
+        import asyncio
+        if state.restarts_remaining > 0:
+            state.restarts_remaining -= 1
+        state.state = "RESTARTING"
+        await self._gcs.update_actor(state.actor_id_hex,
+                                     {"state": "RESTARTING"})
+        await asyncio.sleep(ray_config().actor_restart_backoff_ms / 1000.0)
+        try:
+            await self._create_actor_async(state)
+            return state.state == "ALIVE"
+        except Exception:
+            return False
+
+    def _fail_actor_task(self, spec, refs, exc) -> None:
+        blob = serialization.serialize_error(exc).to_bytes()
+        for r in refs:
+            entry = self._owned_entry(r.hex())
+            if not entry.fut.done():
+                entry.fut.set_result(("inline", blob))
+        gen = self._generators.pop(spec["task_id"], None)
+        if gen is not None:
+            gen._finish(exc)
+
+    def kill_actor(self, handle, no_restart: bool = True) -> None:
+        aid = handle._ray_actor_id.hex()
+        state = self._actors.get(aid)
+        if no_restart and state is not None:
+            state.restarts_remaining = 0
+            state.creation = None
+
+        async def _kill():
+            try:
+                info = await self._gcs.get_actor(actor_id=aid)
+                await self._gcs.update_actor(aid, {
+                    "state": "DEAD", "death_cause": "ray.kill"})
+                if info and info.get("address"):
+                    client = await self._worker_client(info["address"])
+                    await client.notify("exit_worker")
+            except Exception:
+                pass
+
+        self._loop.run(_kill(), timeout=10)
+        if state is not None:
+            state.state = "DEAD"
+
+    def get_actor(self, name: str, namespace: Optional[str] = None):
+        from ray_tpu.core.actor import ActorHandle
+
+        info = self._loop.run(self._gcs.get_actor(
+            name=name, namespace=namespace or self.namespace))
+        if info is None or info.get("state") == "DEAD":
+            raise ValueError(f"Failed to look up actor with name '{name}'")
+        actor_id = ActorID(bytes.fromhex(info["actor_id"]))
+        return ActorHandle(actor_id, info.get("class_name", "Actor"),
+                           info.get("method_meta", {}), runtime=self)
+
+    def cancel(self, ref: ObjectRef, force: bool = False,
+               recursive: bool = True) -> None:
+        # Best-effort: tasks already pushed cannot be preempted in v1.
+        pass
+
+    # ==================================================================
+    # owner-side RPC service (reference: CoreWorkerService pubsub/locations)
+    # ==================================================================
+    async def handle_get_object_locations(self, conn: ServerConnection, *,
+                                          oid: str) -> Optional[dict]:
+        with self._owned_lock:
+            entry = self._owned.get(oid)
+        if entry is None:
+            return None
+        if not entry.fut.done():
+            return {"pending": True, "nodes": []}
+        kind, payload = entry.fut.result()
+        if kind == "inline":
+            return {"inline": payload}
+        return {"nodes": list(entry.nodes)}
+
+    async def handle_generator_item(self, conn: ServerConnection, *,
+                                    task_id: str, oid: str,
+                                    inline: Optional[bytes] = None,
+                                    node: Optional[str] = None) -> bool:
+        entry = self._owned_entry(oid)
+        if node:
+            entry.nodes.append(node)
+            entry.is_stored = True
+            if not entry.fut.done():
+                entry.fut.set_result(("node", node))
+        elif not entry.fut.done():
+            entry.fut.set_result(("inline", inline))
+        gen = self._generators.get(task_id)
+        if gen is not None:
+            gen._push(ObjectRef(ObjectID(bytes.fromhex(oid)),
+                                owner=self.address, runtime=self))
+        return True
+
+    async def handle_ping(self, conn: ServerConnection) -> str:
+        return "pong"
+
+    # ==================================================================
+    # worker-mode execution (reference: core_worker.cc:2596 ExecuteTask +
+    # _raylet.pyx task_execution_handler)
+    # ==================================================================
+    def _resolve_task_args(self, args_blob: bytes):
+        args, kwargs = self._deserialize_payload(args_blob)
+        args = [self.get(a) if isinstance(a, ObjectRef) else a for a in args]
+        kwargs = {k: self.get(v) if isinstance(v, ObjectRef) else v
+                  for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _package_result(self, oid: str, value: Any,
+                        is_error: bool = False) -> dict:
+        so = (serialization.serialize_error(value) if is_error
+              else serialization.serialize(value))
+        size = so.total_size()
+        if size <= ray_config().max_direct_call_object_size:
+            return {"oid": oid, "inline": so.to_bytes()}
+        shm_name = self._loop.run(
+            self._raylet.call("create_object", oid=oid, size=size))
+        self._shm.write(shm_name, lambda buf: so.write_into(
+            _WriteIntoShm(buf)))
+        self._loop.run(self._raylet.call("seal_object", oid=oid))
+        return {"oid": oid, "node": self.raylet_address}
+
+    def _execute_task(self, spec: dict) -> dict:
+        from ray_tpu.runtime_context import (_reset_task_context,
+                                             _set_task_context)
+
+        task_id = spec["task_id"]
+        num_returns = spec["num_returns"]
+        name = spec.get("name", "task")
+        results: List[dict] = []
+        token = _set_task_context(
+            task_id=TaskID(bytes.fromhex(task_id)))
+        try:
+            fn = self._fn.fetch(spec["fn_key"])
+            args, kwargs = self._resolve_task_args(spec["args"])
+            value = fn(*args, **kwargs)
+            results = self._package_returns(task_id, num_returns, name,
+                                            value)
+        except BaseException as e:  # noqa: BLE001
+            results = self._package_error(task_id, num_returns, name, e)
+        finally:
+            _reset_task_context(token)
+        return {"results": results}
+
+    def _package_returns(self, task_id: str, num_returns: int, name: str,
+                         value: Any) -> List[dict]:
+        def oid_for(i):
+            return ObjectID.for_return(
+                TaskID(bytes.fromhex(task_id)), i + 1).hex()
+
+        if num_returns == 1:
+            return [self._package_result(oid_for(0), value)]
+        if num_returns == 0:
+            return []
+        if not isinstance(value, (tuple, list)) or len(value) != num_returns:
+            err = ValueError(
+                f"Task declared num_returns={num_returns} but returned "
+                f"{type(value).__name__}")
+            return self._package_error(task_id, num_returns, name, err)
+        return [self._package_result(oid_for(i), v)
+                for i, v in enumerate(value)]
+
+    def _package_error(self, task_id: str, num_returns: int, name: str,
+                       exc: BaseException) -> List[dict]:
+        wrapped = (exc if isinstance(exc, (RayTaskError, RayActorError,
+                                           TaskCancelledError))
+                   else RayTaskError.from_exception(name, exc))
+        out = []
+        for i in range(max(num_returns, 1)):
+            oid = ObjectID.for_return(
+                TaskID(bytes.fromhex(task_id)), i + 1).hex()
+            out.append(self._package_result(oid, wrapped, is_error=True))
+        return out
+
+    async def handle_push_task(self, conn: ServerConnection, *,
+                               spec: dict) -> dict:
+        import asyncio
+
+        if spec.get("streaming"):
+            return await self._execute_streaming(spec, actor=False)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._exec_pool, self._execute_task, spec)
+
+    async def _execute_streaming(self, spec: dict, actor: bool) -> dict:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        owner_addr = spec["owner"]
+        task_id = spec["task_id"]
+
+        def run() -> Optional[bytes]:
+            try:
+                if actor:
+                    method = getattr(self._actor_instance, spec["method"])
+                    args, kwargs = self._resolve_task_args(spec["args"])
+                    it = method(*args, **kwargs)
+                else:
+                    fn = self._fn.fetch(spec["fn_key"])
+                    args, kwargs = self._resolve_task_args(spec["args"])
+                    it = fn(*args, **kwargs)
+                idx = 0
+                for item in it:
+                    idx += 1
+                    oid = ObjectID.for_return(
+                        TaskID(bytes.fromhex(task_id)), idx).hex()
+                    res = self._package_result(oid, item)
+                    fut = asyncio.run_coroutine_threadsafe(
+                        self._push_generator_item(owner_addr, task_id, res),
+                        loop)
+                    fut.result()
+                return None
+            except BaseException as e:  # noqa: BLE001
+                wrapped = (e if isinstance(e, RayTaskError)
+                           else RayTaskError.from_exception(
+                               spec.get("name", "task"), e))
+                return serialization.serialize_error(wrapped).to_bytes()
+
+        pool = (self._actor_executor if actor and self._actor_executor
+                else self._exec_pool)
+        error_blob = await loop.run_in_executor(pool, run)
+        return {"results": [], "done": True, "error_blob": error_blob}
+
+    async def _push_generator_item(self, owner_addr: str, task_id: str,
+                                   res: dict) -> None:
+        client = await self._worker_client(owner_addr)
+        await client.call("generator_item", task_id=task_id,
+                          oid=res["oid"], inline=res.get("inline"),
+                          node=res.get("node"), timeout=30.0)
+
+    # -- actor execution -----------------------------------------------
+    async def handle_actor_init(self, conn: ServerConnection, *,
+                                actor_id: str, cls_key: str, args: bytes,
+                                max_concurrency: Optional[int],
+                                owner: str) -> dict:
+        import asyncio
+        import inspect as _inspect
+
+        loop = asyncio.get_running_loop()
+
+        def init() -> Optional[bytes]:
+            try:
+                cls = self._fn.fetch(cls_key)
+                rargs, rkwargs = self._resolve_task_args(args)
+                self._actor_instance = cls(*rargs, **rkwargs)
+                is_async = any(
+                    _inspect.iscoroutinefunction(m)
+                    or _inspect.isasyncgenfunction(m)
+                    for _, m in _inspect.getmembers(cls, callable))
+                conc = max_concurrency or (100 if is_async else 1)
+                self._actor_executor = (
+                    concurrent.futures.ThreadPoolExecutor(
+                        max_workers=conc, thread_name_prefix="actor-exec"))
+                if is_async:
+                    import asyncio as aio
+                    self._actor_loop = aio.new_event_loop()
+                    threading.Thread(target=self._actor_loop.run_forever,
+                                     daemon=True).start()
+                self._actor_id_hex = actor_id
+                return None
+            except BaseException as e:  # noqa: BLE001
+                wrapped = (e if isinstance(e, RayTaskError)
+                           else RayTaskError.from_exception(
+                               f"{cls_key}.__init__", e))
+                return serialization.serialize_error(wrapped).to_bytes()
+
+        error_blob = await loop.run_in_executor(self._exec_pool, init)
+        return {"error_blob": error_blob}
+
+    def _execute_actor_method(self, spec: dict) -> dict:
+        from ray_tpu.runtime_context import (_reset_task_context,
+                                             _set_task_context)
+        import asyncio
+        import inspect as _inspect
+
+        task_id = spec["task_id"]
+        num_returns = spec["num_returns"]
+        name = spec.get("name", "method")
+        token = _set_task_context(
+            task_id=TaskID(bytes.fromhex(task_id)),
+            actor_id=ActorID(bytes.fromhex(spec["actor_id"])))
+        try:
+            method = getattr(self._actor_instance, spec["method"])
+            args, kwargs = self._resolve_task_args(spec["args"])
+            value = method(*args, **kwargs)
+            if _inspect.iscoroutine(value):
+                value = asyncio.run_coroutine_threadsafe(
+                    value, self._actor_loop).result()
+            results = self._package_returns(task_id, num_returns, name,
+                                            value)
+        except BaseException as e:  # noqa: BLE001
+            results = self._package_error(task_id, num_returns, name, e)
+        finally:
+            _reset_task_context(token)
+        return {"results": results}
+
+    async def handle_push_actor_task(self, conn: ServerConnection, *,
+                                     spec: dict) -> dict:
+        import asyncio
+
+        if self._actor_instance is None:
+            raise RpcError("no actor instance on this worker")
+        if spec.get("streaming"):
+            return await self._execute_streaming(spec, actor=True)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._actor_executor or self._exec_pool,
+            self._execute_actor_method, spec)
+
+    async def handle_exit_worker(self, conn: ServerConnection) -> bool:
+        import asyncio
+
+        async def _die():
+            await asyncio.sleep(0.05)
+            os._exit(0)
+
+        asyncio.ensure_future(_die())
+        return True
+
+    # ==================================================================
+    # cluster introspection
+    # ==================================================================
+    def nodes(self) -> List[dict]:
+        raw = self._loop.run(self._gcs.get_nodes())
+        return [{
+            "NodeID": n["node_id"],
+            "Alive": n["alive"],
+            "Resources": n.get("resources_total", {}),
+            "NodeManagerAddress": n.get("address"),
+            "IsHeadNode": n.get("is_head", False),
+            "Labels": n.get("labels", {}),
+        } for n in raw]
+
+    def cluster_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for n in self._loop.run(self._gcs.get_nodes()):
+            if not n.get("alive"):
+                continue
+            for k, v in n.get("resources_total", {}).items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    def available_resources(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for n in self._loop.run(self._gcs.get_nodes()):
+            if not n.get("alive"):
+                continue
+            for k, v in n.get("resources_available", {}).items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    # -- internal kv ----------------------------------------------------
+    def kv_put(self, key: bytes, value: bytes, overwrite: bool = True):
+        k = key.decode() if isinstance(key, bytes) else key
+        return self._loop.run(self._gcs.kv_put(k, value, overwrite))
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        k = key.decode() if isinstance(key, bytes) else key
+        return self._loop.run(self._gcs.kv_get(k))
+
+    def kv_del(self, key: bytes) -> None:
+        k = key.decode() if isinstance(key, bytes) else key
+        self._loop.run(self._gcs.kv_del(k))
+
+    def kv_keys(self, prefix: bytes) -> List[bytes]:
+        p = prefix.decode() if isinstance(prefix, bytes) else prefix
+        return [k.encode() for k in self._loop.run(self._gcs.kv_keys(p))]

@@ -1,0 +1,56 @@
+"""Function/actor-class export over GCS KV.
+
+Reference equivalent: `python/ray/_private/function_manager.py` (export at
+`:228`, fetch at `:297`) + `GcsFunctionManager`: a function is pickled once
+per job, stored under a content-hash key in the GCS KV, and fetched+cached by
+workers on first use.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Callable, Dict
+
+import cloudpickle
+
+
+def _hash_blob(blob: bytes) -> str:
+    return hashlib.sha256(blob).hexdigest()[:32]
+
+
+class FunctionManager:
+    def __init__(self, kv_put, kv_get):
+        """kv_put(key: str, value: bytes, overwrite) / kv_get(key) -> bytes;
+        both synchronous callables provided by the runtime."""
+        self._kv_put = kv_put
+        self._kv_get = kv_get
+        self._exported: Dict[int, str] = {}   # id(obj) -> key
+        self._cache: Dict[str, Any] = {}      # key -> callable/class
+        self._lock = threading.Lock()
+
+    def export(self, obj: Callable) -> str:
+        with self._lock:
+            key = self._exported.get(id(obj))
+            if key is not None:
+                return key
+        blob = cloudpickle.dumps(obj)
+        key = f"fn:{_hash_blob(blob)}"
+        self._kv_put(key, blob, False)
+        with self._lock:
+            self._exported[id(obj)] = key
+            self._cache[key] = obj
+        return key
+
+    def fetch(self, key: str) -> Any:
+        with self._lock:
+            obj = self._cache.get(key)
+            if obj is not None:
+                return obj
+        blob = self._kv_get(key)
+        if blob is None:
+            raise KeyError(f"function blob {key} not found in GCS")
+        obj = cloudpickle.loads(blob)
+        with self._lock:
+            self._cache[key] = obj
+        return obj

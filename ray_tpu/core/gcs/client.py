@@ -1,0 +1,119 @@
+"""GCS client: typed accessors over the RPC client.
+
+Reference equivalent: `src/ray/gcs/gcs_client/accessor.h` (Node/Actor/Job/
+InternalKV accessors) + `python/ray/_raylet.pyx:2473 GcsClient`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.core.rpc import RpcClient
+
+
+class GcsClient:
+    def __init__(self, address: str):
+        self.rpc = RpcClient(address)
+
+    async def connect(self, timeout: float = 10.0) -> None:
+        await self.rpc.connect(timeout=timeout)
+
+    async def close(self) -> None:
+        await self.rpc.close()
+
+    # -- pubsub ---------------------------------------------------------
+    async def subscribe(self, channel: str,
+                        handler: Callable[[Any], Any]) -> None:
+        self.rpc.on_push(channel, handler)
+        await self.rpc.call("subscribe", channel=channel)
+
+    async def publish(self, channel: str, data: Any) -> None:
+        await self.rpc.call("publish", channel=channel, data=data)
+
+    # -- nodes ----------------------------------------------------------
+    async def register_node(self, **kwargs: Any) -> Dict[str, Any]:
+        return await self.rpc.call("register_node", **kwargs)
+
+    async def heartbeat(self, node_id: str,
+                        resources_available: Dict[str, float],
+                        load: Optional[dict] = None) -> None:
+        await self.rpc.call("heartbeat", node_id=node_id,
+                            resources_available=resources_available,
+                            load=load, timeout=5.0)
+
+    async def get_nodes(self) -> List[Dict[str, Any]]:
+        return await self.rpc.call("get_nodes")
+
+    async def drain_node(self, node_id: str) -> None:
+        await self.rpc.call("drain_node", node_id=node_id)
+
+    # -- actors ---------------------------------------------------------
+    async def register_actor(self, actor_id: str,
+                             info: Dict[str, Any]) -> Dict[str, Any]:
+        return await self.rpc.call("register_actor", actor_id=actor_id,
+                                   info=info)
+
+    async def update_actor(self, actor_id: str,
+                           updates: Dict[str, Any]) -> bool:
+        return await self.rpc.call("update_actor", actor_id=actor_id,
+                                   updates=updates)
+
+    async def get_actor(self, actor_id: Optional[str] = None,
+                        name: Optional[str] = None,
+                        namespace: str = "default"
+                        ) -> Optional[Dict[str, Any]]:
+        return await self.rpc.call("get_actor", actor_id=actor_id, name=name,
+                                   namespace=namespace)
+
+    async def list_actors(self) -> List[Dict[str, Any]]:
+        return await self.rpc.call("list_actors")
+
+    # -- jobs -----------------------------------------------------------
+    async def add_job(self, job_id: str, info: Dict[str, Any]) -> None:
+        await self.rpc.call("add_job", job_id=job_id, info=info)
+
+    async def mark_job_finished(self, job_id: str) -> None:
+        await self.rpc.call("mark_job_finished", job_id=job_id)
+
+    async def list_jobs(self) -> List[Dict[str, Any]]:
+        return await self.rpc.call("list_jobs")
+
+    # -- kv -------------------------------------------------------------
+    async def kv_put(self, key: str, value: bytes,
+                     overwrite: bool = True) -> bool:
+        return await self.rpc.call("kv_put", key=key, value=value,
+                                   overwrite=overwrite)
+
+    async def kv_get(self, key: str) -> Optional[bytes]:
+        return await self.rpc.call("kv_get", key=key)
+
+    async def kv_del(self, key: str) -> bool:
+        return await self.rpc.call("kv_del", key=key)
+
+    async def kv_keys(self, prefix: str) -> List[str]:
+        return await self.rpc.call("kv_keys", prefix=prefix)
+
+    async def kv_exists(self, key: str) -> bool:
+        return await self.rpc.call("kv_exists", key=key)
+
+    # -- placement groups ------------------------------------------------
+    async def register_placement_group(self, pg_id: str,
+                                       info: Dict[str, Any]) -> bool:
+        return await self.rpc.call("register_placement_group", pg_id=pg_id,
+                                   info=info)
+
+    async def update_placement_group(self, pg_id: str,
+                                     updates: Dict[str, Any]) -> bool:
+        return await self.rpc.call("update_placement_group", pg_id=pg_id,
+                                   updates=updates)
+
+    async def get_placement_group(self, pg_id: str
+                                  ) -> Optional[Dict[str, Any]]:
+        return await self.rpc.call("get_placement_group", pg_id=pg_id)
+
+    # -- misc -----------------------------------------------------------
+    async def ping(self) -> str:
+        return await self.rpc.call("ping", timeout=5.0)
+
+    async def cluster_info(self) -> Dict[str, Any]:
+        return await self.rpc.call("cluster_info")

@@ -1,0 +1,342 @@
+"""GCS — the cluster-global control plane.
+
+Reference equivalent: `src/ray/gcs/gcs_server/` (GcsNodeManager,
+GcsActorManager tables, GcsKvManager, InternalPubSub, GcsHealthCheckManager,
+GcsJobManager — `gcs_server.cc:189-237` init sequence). Design deviation:
+actor *placement* is owner-led (the creating worker leases the actor worker
+itself, like a task); the GCS stores the actor table, watches liveness, and
+publishes updates. GCS-led scheduling of detached actors is layered on top
+via the same table.
+
+State is held in a pluggable store (in-memory now, matching the reference's
+`InMemoryStoreClient`; a persistent backend can be swapped in for GCS
+fault tolerance like `RedisStoreClient`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional, Set
+
+from ray_tpu.core.config import ray_config
+from ray_tpu.core.rpc import RpcServer, ServerConnection
+
+logger = logging.getLogger(__name__)
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._rpc = RpcServer(self, host, port)
+        # -- tables (reference: gcs_table_storage.h) ----------------------
+        self.nodes: Dict[str, Dict[str, Any]] = {}       # node_id hex -> info
+        self.actors: Dict[str, Dict[str, Any]] = {}      # actor_id hex -> info
+        self.named_actors: Dict[str, str] = {}           # "ns/name" -> actor id
+        self.jobs: Dict[str, Dict[str, Any]] = {}
+        self.placement_groups: Dict[str, Dict[str, Any]] = {}
+        self.kv: Dict[str, bytes] = {}
+        self.workers: Dict[str, Dict[str, Any]] = {}
+        # -- pubsub (reference: InternalPubSub / pubsub/) -----------------
+        self._subs: Dict[str, Set[ServerConnection]] = {}
+        self._heartbeats: Dict[str, float] = {}
+        self._health_task: Optional[asyncio.Task] = None
+        self._start_time = time.time()
+
+    @property
+    def address(self) -> str:
+        return self._rpc.address
+
+    async def start(self) -> None:
+        await self._rpc.start()
+        self._health_task = asyncio.ensure_future(self._health_loop())
+        logger.info("GCS listening on %s", self.address)
+
+    async def stop(self) -> None:
+        if self._health_task:
+            self._health_task.cancel()
+        await self._rpc.stop()
+
+    # ------------------------------------------------------------------
+    # health checking (reference: gcs_health_check_manager.h:39)
+    # ------------------------------------------------------------------
+    async def _health_loop(self) -> None:
+        cfg = ray_config()
+        period = cfg.health_check_period_ms / 1000.0
+        threshold = cfg.health_check_failure_threshold
+        while True:
+            await asyncio.sleep(period)
+            now = time.time()
+            for node_id, info in list(self.nodes.items()):
+                if not info.get("alive"):
+                    continue
+                last = self._heartbeats.get(node_id, now)
+                if now - last > period * threshold:
+                    logger.warning("node %s missed heartbeats; marking dead",
+                                   node_id[:8])
+                    await self._mark_node_dead(node_id)
+
+    async def _mark_node_dead(self, node_id: str) -> None:
+        info = self.nodes.get(node_id)
+        if info is None or not info.get("alive"):
+            return
+        info["alive"] = False
+        info["end_time"] = time.time()
+        await self._publish("node", {"node_id": node_id, "alive": False})
+        # Fail actors that lived on the node.
+        for actor_id, a in self.actors.items():
+            if a.get("node_id") == node_id and a["state"] not in (
+                    "DEAD",):
+                a["state"] = "DEAD"
+                a["death_cause"] = "node_died"
+                await self._publish(f"actor:{actor_id}", a)
+
+    # ------------------------------------------------------------------
+    # pubsub
+    # ------------------------------------------------------------------
+    async def _publish(self, channel: str, data: Any) -> None:
+        for conn in list(self._subs.get(channel, ())):
+            if conn.closed:
+                self._subs[channel].discard(conn)
+            else:
+                await conn.push(channel, data)
+
+    async def handle_subscribe(self, conn: ServerConnection, *,
+                               channel: str) -> bool:
+        self._subs.setdefault(channel, set()).add(conn)
+        conn.metadata.setdefault("channels", set()).add(channel)
+        return True
+
+    async def handle_unsubscribe(self, conn: ServerConnection, *,
+                                 channel: str) -> bool:
+        self._subs.get(channel, set()).discard(conn)
+        return True
+
+    async def handle_publish(self, conn: ServerConnection, *, channel: str,
+                             data: Any) -> bool:
+        await self._publish(channel, data)
+        return True
+
+    async def on_client_disconnect(self, conn: ServerConnection) -> None:
+        for channel in conn.metadata.get("channels", ()):
+            self._subs.get(channel, set()).discard(conn)
+        node_id = conn.metadata.get("node_id")
+        if node_id:
+            await self._mark_node_dead(node_id)
+        worker_id = conn.metadata.get("worker_id")
+        if worker_id and worker_id in self.workers:
+            self.workers[worker_id]["alive"] = False
+
+    # ------------------------------------------------------------------
+    # nodes (reference: GcsNodeManager + NodeInfoGcsService)
+    # ------------------------------------------------------------------
+    async def handle_register_node(self, conn: ServerConnection, *,
+                                   node_id: str, address: str,
+                                   object_store_address: str,
+                                   resources: Dict[str, float],
+                                   labels: Dict[str, str],
+                                   is_head: bool = False) -> Dict[str, Any]:
+        self.nodes[node_id] = {
+            "node_id": node_id,
+            "address": address,
+            "object_store_address": object_store_address,
+            "resources_total": resources,
+            "resources_available": dict(resources),
+            "labels": labels,
+            "alive": True,
+            "is_head": is_head,
+            "start_time": time.time(),
+        }
+        self._heartbeats[node_id] = time.time()
+        conn.metadata["node_id"] = node_id
+        await self._publish("node", {"node_id": node_id, "alive": True})
+        return {"ok": True}
+
+    async def handle_heartbeat(self, conn: ServerConnection, *, node_id: str,
+                               resources_available: Dict[str, float],
+                               load: Optional[Dict[str, Any]] = None) -> bool:
+        self._heartbeats[node_id] = time.time()
+        info = self.nodes.get(node_id)
+        if info is not None:
+            info["resources_available"] = resources_available
+            if load is not None:
+                info["load"] = load
+        return True
+
+    async def handle_get_nodes(self, conn: ServerConnection,
+                               ) -> List[Dict[str, Any]]:
+        return list(self.nodes.values())
+
+    async def handle_drain_node(self, conn: ServerConnection, *,
+                                node_id: str) -> bool:
+        await self._mark_node_dead(node_id)
+        return True
+
+    # ------------------------------------------------------------------
+    # actors (reference: GcsActorManager; lifecycle gcs_actor_manager.h:251)
+    # ------------------------------------------------------------------
+    async def handle_register_actor(self, conn: ServerConnection, *,
+                                    actor_id: str, info: Dict[str, Any]
+                                    ) -> Dict[str, Any]:
+        name = info.get("name")
+        ns = info.get("namespace") or "default"
+        if name:
+            key = f"{ns}/{name}"
+            existing = self.named_actors.get(key)
+            if existing is not None:
+                state = self.actors.get(existing, {}).get("state")
+                if state not in ("DEAD", None):
+                    return {"ok": False,
+                            "error": f"actor name '{name}' already taken in "
+                                     f"namespace '{ns}'"}
+            self.named_actors[key] = actor_id
+        info = dict(info, actor_id=actor_id, state=info.get("state",
+                                                            "PENDING"))
+        self.actors[actor_id] = info
+        await self._publish(f"actor:{actor_id}", info)
+        return {"ok": True}
+
+    async def handle_update_actor(self, conn: ServerConnection, *,
+                                  actor_id: str,
+                                  updates: Dict[str, Any]) -> bool:
+        info = self.actors.get(actor_id)
+        if info is None:
+            return False
+        info.update(updates)
+        await self._publish(f"actor:{actor_id}", info)
+        if info.get("state") == "DEAD":
+            name = info.get("name")
+            ns = info.get("namespace") or "default"
+            if name and self.named_actors.get(f"{ns}/{name}") == actor_id:
+                del self.named_actors[f"{ns}/{name}"]
+        return True
+
+    async def handle_get_actor(self, conn: ServerConnection, *,
+                               actor_id: Optional[str] = None,
+                               name: Optional[str] = None,
+                               namespace: str = "default"
+                               ) -> Optional[Dict[str, Any]]:
+        if actor_id is None and name is not None:
+            actor_id = self.named_actors.get(f"{namespace}/{name}")
+        if actor_id is None:
+            return None
+        return self.actors.get(actor_id)
+
+    async def handle_list_actors(self, conn: ServerConnection
+                                 ) -> List[Dict[str, Any]]:
+        return list(self.actors.values())
+
+    # ------------------------------------------------------------------
+    # jobs (reference: GcsJobManager)
+    # ------------------------------------------------------------------
+    async def handle_add_job(self, conn: ServerConnection, *, job_id: str,
+                             info: Dict[str, Any]) -> bool:
+        self.jobs[job_id] = dict(info, job_id=job_id,
+                                 start_time=time.time())
+        return True
+
+    async def handle_mark_job_finished(self, conn: ServerConnection, *,
+                                       job_id: str) -> bool:
+        if job_id in self.jobs:
+            self.jobs[job_id]["finished"] = True
+            self.jobs[job_id]["end_time"] = time.time()
+        return True
+
+    async def handle_list_jobs(self, conn: ServerConnection
+                               ) -> List[Dict[str, Any]]:
+        return list(self.jobs.values())
+
+    # ------------------------------------------------------------------
+    # internal KV (reference: GcsKvManager / InternalKV service)
+    # ------------------------------------------------------------------
+    async def handle_kv_put(self, conn: ServerConnection, *, key: bytes,
+                            value: bytes, overwrite: bool = True) -> bool:
+        k = key.decode() if isinstance(key, bytes) else key
+        if not overwrite and k in self.kv:
+            return False
+        self.kv[k] = value
+        return True
+
+    async def handle_kv_get(self, conn: ServerConnection, *,
+                            key: bytes) -> Optional[bytes]:
+        k = key.decode() if isinstance(key, bytes) else key
+        return self.kv.get(k)
+
+    async def handle_kv_del(self, conn: ServerConnection, *,
+                            key: bytes) -> bool:
+        k = key.decode() if isinstance(key, bytes) else key
+        return self.kv.pop(k, None) is not None
+
+    async def handle_kv_keys(self, conn: ServerConnection, *,
+                             prefix: str) -> List[str]:
+        return [k for k in self.kv if k.startswith(prefix)]
+
+    async def handle_kv_exists(self, conn: ServerConnection, *,
+                               key: bytes) -> bool:
+        k = key.decode() if isinstance(key, bytes) else key
+        return k in self.kv
+
+    # ------------------------------------------------------------------
+    # placement groups (table only; 2PC runs between owner and raylets)
+    # ------------------------------------------------------------------
+    async def handle_register_placement_group(
+            self, conn: ServerConnection, *, pg_id: str,
+            info: Dict[str, Any]) -> bool:
+        self.placement_groups[pg_id] = dict(info, pg_id=pg_id)
+        return True
+
+    async def handle_update_placement_group(
+            self, conn: ServerConnection, *, pg_id: str,
+            updates: Dict[str, Any]) -> bool:
+        if pg_id not in self.placement_groups:
+            return False
+        self.placement_groups[pg_id].update(updates)
+        await self._publish(f"pg:{pg_id}", self.placement_groups[pg_id])
+        return True
+
+    async def handle_get_placement_group(
+            self, conn: ServerConnection, *,
+            pg_id: str) -> Optional[Dict[str, Any]]:
+        return self.placement_groups.get(pg_id)
+
+    async def handle_list_placement_groups(
+            self, conn: ServerConnection) -> List[Dict[str, Any]]:
+        return list(self.placement_groups.values())
+
+    # ------------------------------------------------------------------
+    # misc
+    # ------------------------------------------------------------------
+    async def handle_ping(self, conn: ServerConnection) -> str:
+        return "pong"
+
+    async def handle_cluster_info(self, conn: ServerConnection
+                                  ) -> Dict[str, Any]:
+        return {
+            "address": self.address,
+            "uptime": time.time() - self._start_time,
+            "num_nodes": sum(1 for n in self.nodes.values() if n["alive"]),
+        }
+
+
+def main() -> None:
+    """`python -m ray_tpu.core.gcs.server --port P` — standalone GCS."""
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        server = GcsServer(args.host, args.port)
+        await server.start()
+        print(f"GCS_ADDRESS={server.address}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

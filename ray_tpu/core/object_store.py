@@ -1,0 +1,226 @@
+"""Per-node shared-memory object store (plasma equivalent), hosted inside
+the raylet process like the reference hosts plasma in-process
+(`src/ray/object_manager/plasma/store_runner.h`).
+
+Design: one POSIX shm segment per object (`multiprocessing.shared_memory`),
+named from the object id — workers on the node attach by name for zero-copy
+reads; only control messages (create/seal/get/delete) cross the RPC socket,
+the data plane is mmap. Node-to-node transfer (reference:
+`object_manager/` push/pull) fetches the payload over the raylet RPC channel
+and re-seals it locally. Capacity is enforced with LRU eviction of
+unreferenced sealed objects (reference: `eviction_policy.h`).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory
+from typing import Dict, List, Optional, Set, Tuple
+
+logger = logging.getLogger(__name__)
+
+SHM_PREFIX = "rtpu_"
+
+
+def shm_name_for(object_id_hex: str) -> str:
+    # shm names are limited (~31 chars portable); ids are unique enough
+    # truncated.
+    return SHM_PREFIX + object_id_hex[:24]
+
+
+@dataclass
+class _Entry:
+    size: int
+    shm: shared_memory.SharedMemory
+    sealed: bool = False
+    created_at: float = field(default_factory=time.time)
+    # pins: worker ids currently using the buffer (get in flight)
+    pins: Set[str] = field(default_factory=set)
+
+
+class LocalObjectStore:
+    """The in-raylet store state machine (no I/O here; the raylet wires it
+    to RPC handlers)."""
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity = capacity_bytes
+        self.used = 0
+        self._objects: "OrderedDict[str, _Entry]" = OrderedDict()
+
+    # -- create/seal (reference: plasma store.cc ProcessCreateRequests) --
+    def create(self, oid: str, size: int) -> str:
+        if oid in self._objects:
+            entry = self._objects[oid]
+            if entry.sealed:
+                raise FileExistsError(f"object {oid[:8]} already sealed")
+            return entry.shm.name
+        if size > self.capacity:
+            raise MemoryError(
+                f"object of {size} bytes exceeds store capacity "
+                f"{self.capacity}")
+        self._ensure_space(size)
+        name = shm_name_for(oid)
+        try:
+            shm = shared_memory.SharedMemory(name=name, create=True,
+                                             size=max(size, 1))
+        except FileExistsError:
+            # Stale segment from a dead process: reclaim it.
+            stale = shared_memory.SharedMemory(name=name)
+            stale.close()
+            stale.unlink()
+            shm = shared_memory.SharedMemory(name=name, create=True,
+                                             size=max(size, 1))
+        self._objects[oid] = _Entry(size=size, shm=shm)
+        self.used += size
+        return shm.name
+
+    def seal(self, oid: str) -> None:
+        entry = self._objects.get(oid)
+        if entry is None:
+            raise KeyError(f"cannot seal unknown object {oid[:8]}")
+        entry.sealed = True
+        self._objects.move_to_end(oid)
+
+    def put_bytes(self, oid: str, data: bytes) -> None:
+        """Create+write+seal in one step (used by the pull path)."""
+        if self.contains(oid):
+            return
+        self.create(oid, len(data))
+        entry = self._objects[oid]
+        entry.shm.buf[: len(data)] = data
+        self.seal(oid)
+
+    # -- read ------------------------------------------------------------
+    def contains(self, oid: str) -> bool:
+        entry = self._objects.get(oid)
+        return entry is not None and entry.sealed
+
+    def info(self, oid: str) -> Optional[Tuple[str, int]]:
+        entry = self._objects.get(oid)
+        if entry is None or not entry.sealed:
+            return None
+        self._objects.move_to_end(oid)  # LRU touch
+        return entry.shm.name, entry.size
+
+    def read_bytes(self, oid: str) -> bytes:
+        entry = self._objects.get(oid)
+        if entry is None or not entry.sealed:
+            raise KeyError(f"object {oid[:8]} not present/sealed")
+        return bytes(entry.shm.buf[: entry.size])
+
+    def pin(self, oid: str, worker_id: str) -> None:
+        entry = self._objects.get(oid)
+        if entry is not None:
+            entry.pins.add(worker_id)
+
+    def unpin(self, oid: str, worker_id: str) -> None:
+        entry = self._objects.get(oid)
+        if entry is not None:
+            entry.pins.discard(worker_id)
+
+    # -- delete/evict ----------------------------------------------------
+    def delete(self, oid: str) -> bool:
+        entry = self._objects.pop(oid, None)
+        if entry is None:
+            return False
+        self.used -= entry.size
+        try:
+            entry.shm.close()
+            entry.shm.unlink()
+        except FileNotFoundError:
+            pass
+        return True
+
+    def _ensure_space(self, size: int) -> None:
+        if self.used + size <= self.capacity:
+            return
+        # LRU-evict sealed, unpinned objects (reference: eviction_policy.h).
+        for oid in list(self._objects):
+            if self.used + size <= self.capacity:
+                break
+            entry = self._objects[oid]
+            if entry.sealed and not entry.pins:
+                logger.debug("evicting %s (%d bytes)", oid[:8], entry.size)
+                self.delete(oid)
+        if self.used + size > self.capacity:
+            from ray_tpu.exceptions import ObjectStoreFullError
+            raise ObjectStoreFullError(
+                f"store full: need {size}, used {self.used}/{self.capacity} "
+                "and nothing evictable")
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "capacity": self.capacity,
+            "used": self.used,
+            "num_objects": len(self._objects),
+        }
+
+    def shutdown(self) -> None:
+        for oid in list(self._objects):
+            self.delete(oid)
+
+
+def _untrack(shm: shared_memory.SharedMemory) -> None:
+    """The raylet owns segment lifetime; detach this process's
+    resource_tracker registration so it neither warns nor double-unlinks
+    at interpreter exit."""
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+    except Exception:
+        pass
+
+
+class WorkerStoreClient:
+    """Worker-side zero-copy access to the node store: control via raylet
+    RPC (done by the caller), data via direct shm attach (reference:
+    plasma/client.h)."""
+
+    def __init__(self):
+        self._attached: Dict[str, shared_memory.SharedMemory] = {}
+
+    def write(self, shm_name: str, payload_writer) -> None:
+        shm = shared_memory.SharedMemory(name=shm_name)
+        _untrack(shm)
+        try:
+            payload_writer(shm.buf)
+        finally:
+            shm.close()
+
+    def read(self, shm_name: str, size: int) -> memoryview:
+        """Attach and return a zero-copy view. The segment stays attached
+        until `release` (the view must not outlive it)."""
+        shm = self._attached.get(shm_name)
+        if shm is None:
+            shm = shared_memory.SharedMemory(name=shm_name)
+            _untrack(shm)
+            self._attached[shm_name] = shm
+        return shm.buf[:size]
+
+    def release(self, shm_name: str) -> None:
+        shm = self._attached.pop(shm_name, None)
+        if shm is not None:
+            shm.close()
+
+    def close(self) -> None:
+        for shm in self._attached.values():
+            shm.close()
+        self._attached.clear()
+
+
+class _WriteIntoShm:
+    """Adapter: SerializedObject.write_into target backed by an shm buffer."""
+
+    def __init__(self, buf: memoryview):
+        self._buf = buf
+        self._off = 0
+
+    def __iadd__(self, data) -> "_WriteIntoShm":
+        n = len(data)
+        self._buf[self._off: self._off + n] = bytes(data) if not isinstance(
+            data, (bytes, bytearray, memoryview)) else data
+        self._off += n
+        return self

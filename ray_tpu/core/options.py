@@ -44,7 +44,10 @@ class TaskOptions:
 
 @dataclass
 class ActorOptions:
-    num_cpus: float = 1.0
+    # None (unlike tasks): an actor with unspecified num_cpus needs 1 CPU to
+    # be placed but 0 while running (reference: ray_option_utils actor
+    # defaults).
+    num_cpus: Optional[float] = None
     num_gpus: float = 0.0
     resources: Dict[str, float] = field(default_factory=dict)
     memory: Optional[int] = None
@@ -97,10 +100,6 @@ def actor_options(updates: Dict[str, Any],
     opts = dataclasses.replace(base) if base else ActorOptions()
     for k, v in updates.items():
         setattr(opts, k, v)
-    if opts.num_cpus is None:
-        opts.num_cpus = 1.0
-    # Actors default to 0 CPU when only created (reference: actors reserve
-    # num_cpus=0 for placement by default unless specified).
     return opts
 
 

@@ -1,0 +1,511 @@
+"""Raylet: per-node scheduler daemon + object-store host.
+
+Reference equivalent: `src/ray/raylet/` — `NodeManager` (worker leasing
+`node_manager.cc:1767`, scheduling via `ClusterTaskManager`/
+`LocalTaskManager`), `WorkerPool` (`worker_pool.h:156`), and the in-process
+plasma store. The hybrid scheduling policy (pack locally until a utilization
+threshold, then spread; `scheduling/policy/hybrid_scheduling_policy.h:50`)
+drives spillback exactly like the reference: a lease reply may redirect the
+client to another node, which re-requests there.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core.config import ray_config
+from ray_tpu.core.gcs.client import GcsClient
+from ray_tpu.core.object_store import LocalObjectStore
+from ray_tpu.core.rpc import RpcClient, RpcServer, ServerConnection
+
+logger = logging.getLogger(__name__)
+
+
+class _Worker:
+    def __init__(self, worker_id: str, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.address: Optional[str] = None
+        self.state = "starting"  # starting | idle | leased | actor | dead
+        self.lease_id: Optional[str] = None
+        self.ready = asyncio.Event()
+        self.actor_id: Optional[str] = None
+        self.held: Dict[str, float] = {}  # resources held by active lease
+
+
+class _PendingLease:
+    def __init__(self, demand: Dict[str, float], is_actor: bool,
+                 scheduling_key: str):
+        self.demand = demand
+        self.is_actor = is_actor
+        self.scheduling_key = scheduling_key
+        self.conn: Optional[ServerConnection] = None
+        self.future: asyncio.Future = asyncio.get_event_loop().create_future()
+
+
+class Raylet:
+    def __init__(self, *, node_id: str, gcs_address: str,
+                 resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 object_store_memory: Optional[int] = None,
+                 is_head: bool = False):
+        self.node_id = node_id
+        self.gcs_address = gcs_address
+        self.is_head = is_head
+        self.labels = labels or {}
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self._rpc = RpcServer(self, host, port)
+        self._gcs = GcsClient(gcs_address)
+        self.store = LocalObjectStore(
+            object_store_memory or ray_config().object_store_memory_bytes)
+        self._workers: Dict[str, _Worker] = {}
+        self._idle: List[_Worker] = []
+        self._pending: List[_PendingLease] = []
+        self._next_lease = 0
+        self._cluster_view: Dict[str, Dict[str, Any]] = {}
+        self._raylet_clients: Dict[str, RpcClient] = {}
+        self._worker_clients: Dict[str, RpcClient] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._monitors: Dict[str, asyncio.Task] = {}
+
+    @property
+    def address(self) -> str:
+        return self._rpc.address
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        await self._rpc.start()
+        await self._gcs.connect()
+        await self._gcs.register_node(
+            node_id=self.node_id, address=self.address,
+            object_store_address=self.address,
+            resources=self.resources_total, labels=self.labels,
+            is_head=self.is_head)
+        await self._gcs.subscribe("node", self._on_node_update)
+        self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
+        # Prestart a few workers so first-task latency is registration-bound,
+        # not fork/exec-bound (reference: PrestartWorkers,
+        # node_manager.cc:1782).
+        for _ in range(min(int(self.resources_total.get("CPU", 1)), 4)):
+            self._spawn_worker()
+        logger.info("raylet %s listening on %s", self.node_id[:8],
+                    self.address)
+
+    async def stop(self) -> None:
+        for t in self._tasks + list(self._monitors.values()):
+            t.cancel()
+        for w in self._workers.values():
+            if w.proc.poll() is None:
+                w.proc.terminate()
+        for w in self._workers.values():
+            try:
+                w.proc.wait(timeout=2)
+            except Exception:
+                w.proc.kill()
+        self.store.shutdown()
+        await self._rpc.stop()
+        await self._gcs.close()
+
+    async def _heartbeat_loop(self) -> None:
+        period = ray_config().raylet_heartbeat_period_ms / 1000.0
+        while True:
+            try:
+                await self._gcs.heartbeat(
+                    self.node_id, self.resources_available,
+                    load={"pending": len(self._pending)})
+                self._cluster_view = {
+                    n["node_id"]: n for n in await self._gcs.get_nodes()}
+            except Exception:
+                logger.warning("heartbeat to GCS failed", exc_info=True)
+            await asyncio.sleep(period)
+
+    def _on_node_update(self, data) -> None:
+        if not data.get("alive"):
+            self._cluster_view.pop(data.get("node_id"), None)
+
+    # ------------------------------------------------------------------
+    # worker pool (reference: worker_pool.h)
+    # ------------------------------------------------------------------
+    def _spawn_worker(self) -> _Worker:
+        import uuid
+
+        worker_id = uuid.uuid4().hex
+        env = dict(os.environ)
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        cmd = [sys.executable, "-m", "ray_tpu.core.worker_main",
+               "--raylet", self.address, "--gcs", self.gcs_address,
+               "--worker-id", worker_id, "--node-id", self.node_id]
+        log_dir = os.environ.get("RAY_TPU_LOG_DIR")
+        if log_dir:
+            out = open(os.path.join(log_dir, f"worker-{worker_id[:8]}.log"),
+                       "ab")
+        else:
+            out = subprocess.DEVNULL
+        proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=out)
+        worker = _Worker(worker_id, proc)
+        self._workers[worker_id] = worker
+        self._monitors[worker_id] = asyncio.ensure_future(
+            self._monitor_worker(worker))
+        return worker
+
+    async def _monitor_worker(self, worker: _Worker) -> None:
+        while worker.proc.poll() is None:
+            await asyncio.sleep(0.2)
+        code = worker.proc.returncode
+        if worker.state != "dead":
+            worker.state = "dead"
+            if worker in self._idle:
+                self._idle.remove(worker)
+            if worker.held:
+                self._release(worker.held)
+                worker.held = {}
+                self._try_dispatch()
+            if worker.actor_id:
+                try:
+                    await self._gcs.update_actor(worker.actor_id, {
+                        "state": "DEAD",
+                        "death_cause": f"worker exited with code {code}",
+                    })
+                except Exception:
+                    pass
+            logger.info("worker %s exited with code %s",
+                        worker.worker_id[:8], code)
+
+    async def handle_register_worker(self, conn: ServerConnection, *,
+                                     worker_id: str, address: str) -> bool:
+        worker = self._workers.get(worker_id)
+        if worker is None:
+            return False
+        worker.address = address
+        worker.state = "idle"
+        worker.ready.set()
+        self._idle.append(worker)
+        conn.metadata["worker_id"] = worker_id
+        self._try_dispatch()
+        return True
+
+    # ------------------------------------------------------------------
+    # leasing + scheduling (reference: node_manager.cc:1767 +
+    # cluster_task_manager.h:70 + hybrid_scheduling_policy.h:50)
+    # ------------------------------------------------------------------
+    def _fits(self, avail: Dict[str, float],
+              demand: Dict[str, float]) -> bool:
+        return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+
+    def _acquire(self, demand: Dict[str, float]) -> None:
+        for k, v in demand.items():
+            self.resources_available[k] = self.resources_available.get(
+                k, 0.0) - v
+
+    def _release(self, demand: Dict[str, float]) -> None:
+        for k, v in demand.items():
+            self.resources_available[k] = min(
+                self.resources_available.get(k, 0.0) + v,
+                self.resources_total.get(k, v))
+
+    def _pick_spillback(self, demand: Dict[str, float]) -> Optional[str]:
+        """Best remote node that can host the demand now (spread by most
+        available, the scorer's tie-break in the reference)."""
+        best, best_score = None, -1.0
+        for node_id, info in self._cluster_view.items():
+            if node_id == self.node_id or not info.get("alive"):
+                continue
+            avail = info.get("resources_available", {})
+            if not self._fits(avail, demand):
+                continue
+            score = sum(avail.get(k, 0.0) for k in ("CPU", "TPU"))
+            if score > best_score:
+                best, best_score = info["address"], score
+        return best
+
+    async def handle_request_worker_lease(
+            self, conn: ServerConnection, *, resources: Dict[str, float],
+            scheduling_key: str = "", is_actor: bool = False,
+            spillback_count: int = 0) -> Dict[str, Any]:
+        demand = {k: float(v) for k, v in resources.items() if v}
+        cfg = ray_config()
+        local_fits = self._fits(self.resources_available, demand)
+        # Hybrid policy (hybrid_scheduling_policy.h): pack locally while
+        # below the spread threshold; above it — or when local can't fit —
+        # spill to a viable remote. The spillback chain is bounded so two
+        # saturated raylets with stale views of each other can't ping-pong
+        # a lease forever.
+        if spillback_count < 2:
+            utilization = 1.0 - (
+                self.resources_available.get("CPU", 0.0)
+                / max(self.resources_total.get("CPU", 1.0), 1e-9))
+            if not local_fits or utilization > cfg.scheduler_spread_threshold:
+                remote = self._pick_spillback(demand)
+                if remote is not None:
+                    return {"spillback": remote}
+        if not local_fits and not self._feasible_locally(demand):
+            return {"error": "infeasible",
+                    "detail": f"demand {demand} exceeds node total "
+                              f"{self.resources_total}"}
+        pending = _PendingLease(demand, is_actor, scheduling_key)
+        pending.conn = conn
+        self._pending.append(pending)
+        self._try_dispatch()
+        return await pending.future
+
+    def _feasible_locally(self, demand: Dict[str, float]) -> bool:
+        return self._fits(self.resources_total, demand)
+
+    def _try_dispatch(self) -> None:
+        made_progress = True
+        while made_progress and self._pending:
+            made_progress = False
+            for pending in list(self._pending):
+                if not self._fits(self.resources_available, pending.demand):
+                    continue
+                worker = self._get_idle_worker()
+                if worker is None:
+                    # Spawn enough workers for everything runnable now —
+                    # startup is the latency, so batch it (reference:
+                    # PrestartWorkers on the lease path).
+                    starting = sum(1 for w in self._workers.values()
+                                   if w.state == "starting")
+                    for _ in range(len(self._pending) - starting):
+                        if not self._can_start_worker():
+                            break
+                        self._spawn_worker()
+                    break
+                self._pending.remove(pending)
+                self._acquire(pending.demand)
+                self._next_lease += 1
+                lease_id = f"{self.node_id[:8]}-{self._next_lease}"
+                worker.state = "actor" if pending.is_actor else "leased"
+                worker.lease_id = lease_id
+                worker.held = dict(pending.demand)
+                if not pending.future.done():
+                    pending.future.set_result({
+                        "granted": {
+                            "worker_id": worker.worker_id,
+                            "worker_address": worker.address,
+                            "lease_id": lease_id,
+                            "node_id": self.node_id,
+                            "resources": pending.demand,
+                        }})
+                made_progress = True
+
+    def _get_idle_worker(self) -> Optional[_Worker]:
+        while self._idle:
+            worker = self._idle.pop(0)
+            if worker.state == "idle" and worker.proc.poll() is None:
+                return worker
+        return None
+
+    def _can_start_worker(self) -> bool:
+        limit = ray_config().num_workers_soft_limit or int(
+            self.resources_total.get("CPU", 4)) + 2
+        alive = sum(1 for w in self._workers.values() if w.state != "dead")
+        return alive < limit
+
+    async def handle_return_worker(self, conn: ServerConnection, *,
+                                   lease_id: str, worker_id: str,
+                                   resources: Optional[Dict[str, float]]
+                                   = None, dead: bool = False) -> bool:
+        worker = self._workers.get(worker_id)
+        if worker is not None and worker.lease_id == lease_id:
+            # The raylet's own bookkeeping is authoritative for what this
+            # lease holds — not the client's view.
+            self._release(worker.held)
+            worker.held = {}
+            worker.lease_id = None
+            if dead or worker.proc.poll() is not None:
+                worker.state = "dead"
+            else:
+                worker.state = "idle"
+                worker.actor_id = None
+                self._idle.append(worker)
+        self._try_dispatch()
+        return True
+
+    async def handle_mark_actor_worker(self, conn: ServerConnection, *,
+                                       worker_id: str, actor_id: str,
+                                       release: Optional[Dict[str, float]]
+                                       = None) -> bool:
+        """Record the actor on its worker; `release` downgrades the lease to
+        the actor's running demand (placement CPU released after __init__)."""
+        worker = self._workers.get(worker_id)
+        if worker is not None:
+            worker.actor_id = actor_id
+            if release:
+                self._release(release)
+                for k, v in release.items():
+                    worker.held[k] = worker.held.get(k, 0.0) - v
+                    if worker.held[k] <= 1e-9:
+                        del worker.held[k]
+                self._try_dispatch()
+        return True
+
+    # ------------------------------------------------------------------
+    # object store RPCs (reference: plasma protocol + object_manager)
+    # ------------------------------------------------------------------
+    async def handle_create_object(self, conn: ServerConnection, *,
+                                   oid: str, size: int) -> str:
+        return self.store.create(oid, size)
+
+    async def handle_seal_object(self, conn: ServerConnection, *,
+                                 oid: str) -> bool:
+        self.store.seal(oid)
+        return True
+
+    async def handle_object_info(self, conn: ServerConnection, *,
+                                 oid: str) -> Optional[Dict[str, Any]]:
+        info = self.store.info(oid)
+        if info is None:
+            return None
+        name, size = info
+        return {"shm_name": name, "size": size}
+
+    async def handle_read_object(self, conn: ServerConnection, *,
+                                 oid: str) -> Optional[bytes]:
+        """Remote raylet pull (data-plane; single frame)."""
+        if not self.store.contains(oid):
+            return None
+        return self.store.read_bytes(oid)
+
+    async def handle_put_object(self, conn: ServerConnection, *,
+                                oid: str, data: bytes) -> bool:
+        self.store.put_bytes(oid, data)
+        return True
+
+    async def handle_delete_objects(self, conn: ServerConnection, *,
+                                    oids: List[str]) -> int:
+        return sum(1 for oid in oids if self.store.delete(oid))
+
+    async def on_client_disconnect(self, conn: ServerConnection) -> None:
+        """Drop queued lease requests from a vanished client so a later
+        grant doesn't strand a worker + its resources."""
+        for pending in [p for p in self._pending if p.conn is conn]:
+            self._pending.remove(pending)
+            if not pending.future.done():
+                pending.future.cancel()
+
+    async def handle_pull_object(self, conn: ServerConnection, *, oid: str,
+                                 owner_address: Optional[str],
+                                 pull_timeout: float = 30.0
+                                 ) -> Optional[Dict[str, Any]]:
+        """Ensure `oid` is in the local store; returns shm info, inline
+        payload, or None. Resolution order: local store -> owner's location
+        directory (ownership-based object directory,
+        `ownership_based_object_directory.h`) -> remote raylet fetch."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = self.store.info(oid)
+            if info is not None:
+                return {"shm_name": info[0], "size": info[1]}
+            if owner_address:
+                try:
+                    owner = await self._worker_client(owner_address)
+                    loc = await owner.call("get_object_locations", oid=oid,
+                                           timeout=10.0)
+                except Exception as e:
+                    return {"error": f"owner unreachable: {e}"}
+                if loc is None:
+                    return {"error": "owner does not know this object"}
+                if loc.get("inline") is not None:
+                    return {"inline": loc["inline"]}
+                for node_addr in loc.get("nodes", []):
+                    if node_addr == self.address:
+                        continue
+                    try:
+                        remote = await self._raylet_client(node_addr)
+                        data = await remote.call("read_object", oid=oid,
+                                                 timeout=60.0)
+                    except Exception:
+                        continue
+                    if data is not None:
+                        self.store.put_bytes(oid, data)
+                        info = self.store.info(oid)
+                        return {"shm_name": info[0], "size": info[1]}
+                if not loc.get("pending"):
+                    return {"error": "no reachable copy"}
+            await asyncio.sleep(ray_config().object_timeout_ms / 1000.0)
+        return {"error": "timeout"}
+
+    async def _raylet_client(self, address: str) -> RpcClient:
+        client = self._raylet_clients.get(address)
+        if client is None or not client.connected:
+            client = RpcClient(address)
+            await client.connect(timeout=5.0)
+            self._raylet_clients[address] = client
+        return client
+
+    async def _worker_client(self, address: str) -> RpcClient:
+        client = self._worker_clients.get(address)
+        if client is None or not client.connected:
+            client = RpcClient(address)
+            await client.connect(timeout=5.0)
+            self._worker_clients[address] = client
+        return client
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    async def handle_node_stats(self, conn: ServerConnection
+                                ) -> Dict[str, Any]:
+        return {
+            "node_id": self.node_id,
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "num_workers": len([w for w in self._workers.values()
+                                if w.state != "dead"]),
+            "pending_leases": len(self._pending),
+            "store": self.store.stats(),
+        }
+
+    async def handle_ping(self, conn: ServerConnection) -> str:
+        return "pong"
+
+
+def main() -> None:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--object-store-memory", type=int, default=0)
+    parser.add_argument("--head", action="store_true")
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        import signal
+
+        raylet = Raylet(
+            node_id=args.node_id, gcs_address=args.gcs,
+            resources=json.loads(args.resources),
+            object_store_memory=args.object_store_memory or None,
+            is_head=args.head, port=args.port)
+        await raylet.start()
+        print(f"RAYLET_ADDRESS={raylet.address}", flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        # Clean shutdown: kill the worker pool before exiting, so no
+        # orphan workers outlive the node.
+        await raylet.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

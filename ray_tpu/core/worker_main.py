@@ -1,0 +1,57 @@
+"""Worker process entry point.
+
+Reference equivalent: `python/ray/_private/workers/default_worker.py` +
+`Worker.main_loop` (`_private/worker.py:799`): construct the core-worker
+runtime in worker mode, register with the raylet, and serve task pushes
+until told to exit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet", required=True)
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--node-id", required=True)
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[worker {args.worker_id[:8]}] %(message)s")
+
+    from ray_tpu.core.cluster_runtime import ClusterRuntime
+    from ray_tpu.core.worker import set_runtime
+
+    runtime = ClusterRuntime(
+        gcs_address=args.gcs, raylet_address=args.raylet, mode="worker",
+        node_id=args.node_id)
+    set_runtime(runtime)
+
+    ok = runtime._loop.run(runtime._raylet.call(
+        "register_worker", worker_id=args.worker_id,
+        address=runtime.address))
+    if not ok:
+        logging.error("raylet rejected registration; exiting")
+        sys.exit(1)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    # Watchdog: a worker must not outlive its raylet (reference: workers
+    # exit on raylet socket EOF, node_manager disconnect handling).
+    while not stop.wait(timeout=1.0):
+        if not runtime._raylet.connected:
+            logging.info("raylet connection lost; exiting")
+            break
+    runtime.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -123,6 +123,11 @@ class OwnerDiedError(ObjectLostError):
         self.args = (f"Object {object_ref_hex} is unavailable because its owner died.",)
 
 
+class WorkerCrashedError(RayError):
+    """The worker executing the task died unexpectedly (reference:
+    WorkerCrashedError)."""
+
+
 class RuntimeEnvSetupError(RayError):
     pass
 

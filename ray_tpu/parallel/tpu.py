@@ -1,0 +1,87 @@
+"""TPU topology detection and slice-aware resources.
+
+Reference equivalent: `python/ray/_private/accelerators/tpu.py` (single-host
+only: chip autodetection `:73,95`, `TPU_VISIBLE_CHIPS` `:26`). Extended here
+to be pod-aware: a node reports its slice name/topology/worker index as
+labels so the scheduler can gang-place one worker per host of the same slice
+(SURVEY.md §3 build-plan item 3).
+
+Detection is env/sysfs-based (no jax import — raylets must stay light):
+- `RAY_TPU_FAKE_SLICE` — test override, e.g. "v5e-8:2" (topology:hosts)
+- GKE/GCE env: TPU_WORKER_ID, TPU_ACCELERATOR_TYPE, TPU_WORKER_HOSTNAMES
+- /dev/accel* device files (one per chip) or /dev/vfio
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Optional, Tuple
+
+
+def detect_chip_count() -> int:
+    visible = os.environ.get("TPU_VISIBLE_CHIPS")
+    if visible:
+        return len([c for c in visible.split(",") if c.strip()])
+    fake = os.environ.get("RAY_TPU_FAKE_SLICE")
+    if fake:
+        topo = fake.split(":")[0]
+        try:
+            chips_total = int(topo.rsplit("-", 1)[1])
+            hosts = int(fake.split(":")[1]) if ":" in fake else 1
+            return max(chips_total // hosts, 1)
+        except (IndexError, ValueError):
+            return 1
+    accels = glob.glob("/dev/accel*")
+    if accels:
+        return len(accels)
+    if os.path.isdir("/dev/vfio"):
+        n = len([p for p in glob.glob("/dev/vfio/*") if p.rsplit(
+            "/", 1)[-1].isdigit()])
+        if n:
+            return n
+    return 0
+
+
+def slice_info() -> Optional[Dict[str, str]]:
+    """Labels describing the TPU slice this host belongs to, or None."""
+    fake = os.environ.get("RAY_TPU_FAKE_SLICE")
+    accel_type = (os.environ.get("TPU_ACCELERATOR_TYPE")
+                  or (fake.split(":")[0] if fake else None))
+    if accel_type is None and detect_chip_count() == 0:
+        return None
+    worker_id = os.environ.get("TPU_WORKER_ID", "0")
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    num_hosts = (len(hostnames.split(",")) if hostnames
+                 else (int(fake.split(":")[1])
+                       if fake and ":" in fake else 1))
+    slice_name = os.environ.get(
+        "TPU_NAME", f"slice-{accel_type or 'local'}")
+    return {
+        "ray_tpu.slice": slice_name,
+        "ray_tpu.accelerator_type": accel_type or "unknown",
+        "ray_tpu.worker_id": str(worker_id),
+        "ray_tpu.num_hosts": str(num_hosts),
+    }
+
+
+def local_tpu_resources() -> Dict[str, float]:
+    """{"TPU": chips, "TPU-<type>": chips} for this host (resource names
+    match the reference: accelerators.py TPU resource + type constants)."""
+    chips = detect_chip_count()
+    if chips <= 0:
+        return {}
+    out: Dict[str, float] = {"TPU": float(chips)}
+    info = slice_info()
+    if info and info.get("ray_tpu.accelerator_type") not in (None, "unknown"):
+        out[f"TPU-{info['ray_tpu.accelerator_type']}"] = float(chips)
+    return out
+
+
+def visible_chip_env(chip_ids) -> Dict[str, str]:
+    """Env vars isolating a worker to the given chips (reference:
+    tpu.py:214 set_current_process_visible_accelerator_ids)."""
+    ids = ",".join(str(c) for c in chip_ids)
+    return {"TPU_VISIBLE_CHIPS": ids,
+            "TPU_PROCESS_BOUNDS": "1,1,1",
+            "TPU_CHIPS_PER_PROCESS_BOUNDS": "1,1,1"}

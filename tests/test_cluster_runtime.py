@@ -1,0 +1,254 @@
+"""Cluster runtime: real GCS + raylet + worker processes.
+
+Reference coverage class: python/ray/tests/test_basic.py + test_multi_node.py
+on the conftest `ray_start_regular` / `ray_start_cluster` fixtures.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    """One shared single-node cluster for this module (startup ~4s)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_put_get_small_and_large(ray_cluster):
+    ray = ray_cluster
+    assert ray.get(ray.put({"a": 1})) == {"a": 1}
+    arr = np.arange(400000, dtype=np.float32)  # > inline limit -> shm store
+    out = ray.get(ray.put(arr))
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_task_round_trip(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    def mul(a, b):
+        return a * b
+
+    assert ray.get(mul.remote(6, 7)) == 42
+
+
+def test_task_chained_refs(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    def add(a, b):
+        return a + b
+
+    assert ray.get(add.remote(add.remote(1, 2), add.remote(3, 4))) == 10
+
+
+def test_large_task_returns(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    def big(n):
+        return np.ones(n, dtype=np.float64)
+
+    out = ray.get(big.remote(300000))
+    assert out.shape == (300000,) and out.sum() == 300000
+
+
+def test_parallel_tasks(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    def sleepy(i):
+        time.sleep(0.4)
+        return i
+
+    t0 = time.time()
+    out = ray.get([sleepy.remote(i) for i in range(4)])
+    elapsed = time.time() - t0
+    assert sorted(out) == [0, 1, 2, 3]
+    # 4 CPUs -> near-parallel execution, not 1.6s serial.
+    assert elapsed < 1.4, f"tasks did not run in parallel: {elapsed:.2f}s"
+
+
+def test_task_error(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    def boom():
+        raise KeyError("nope")
+
+    with pytest.raises(KeyError):
+        ray.get(boom.remote())
+
+
+def test_multiple_returns(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote(num_returns=2)
+    def pair():
+        return "x", "y"
+
+    a, b = pair.remote()
+    assert ray.get(a) == "x" and ray.get(b) == "y"
+
+
+def test_wait_cluster(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    def fast():
+        return 1
+
+    @ray.remote
+    def slow():
+        time.sleep(5)
+        return 2
+
+    f, s = fast.remote(), slow.remote()
+    ready, pending = ray.wait([f, s], num_returns=1, timeout=4)
+    assert ready == [f] and pending == [s]
+
+
+def test_actor_lifecycle(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    class Bank:
+        def __init__(self, balance):
+            self.balance = balance
+
+        def deposit(self, x):
+            self.balance += x
+            return self.balance
+
+    b = Bank.remote(100)
+    assert ray.get(b.deposit.remote(50)) == 150
+    assert ray.get(b.deposit.remote(25)) == 175
+
+
+def test_actor_ordering_cluster(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    class Log:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+
+        def get(self):
+            return self.items
+
+    log = Log.remote()
+    for i in range(30):
+        log.add.remote(i)
+    assert ray.get(log.get.remote()) == list(range(30))
+
+
+def test_named_actor_cluster(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    class Registry:
+        def ping(self):
+            return "pong"
+
+    Registry.options(name="reg", lifetime="detached").remote()
+    h = ray.get_actor("reg")
+    assert ray.get(h.ping.remote()) == "pong"
+
+
+def test_actor_constructor_error(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("ctor fail")
+
+    with pytest.raises(Exception, match="ctor fail"):
+        Bad.remote()
+
+
+def test_kill_actor_cluster(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    class Victim:
+        def f(self):
+            return 1
+
+    v = Victim.remote()
+    assert ray.get(v.f.remote()) == 1
+    ray.kill(v)
+    with pytest.raises(ray.exceptions.RayActorError):
+        ray.get(v.f.remote(), timeout=30)
+
+
+def test_streaming_generator_cluster(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 2
+
+    assert [ray.get(r) for r in gen.remote(4)] == [0, 2, 4, 6]
+
+
+def test_actor_handle_to_task(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    @ray.remote
+    def bump(c):
+        import ray_tpu
+        return ray_tpu.get(c.incr.remote())
+
+    c = Counter.remote()
+    assert ray.get(bump.remote(c)) == 1
+    assert ray.get(bump.remote(c)) == 2
+
+
+def test_nested_tasks(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    def outer():
+        import ray_tpu
+
+        @ray_tpu.remote
+        def inner(x):
+            return x * 10
+
+        return ray_tpu.get(inner.remote(4))
+
+    assert ray.get(outer.remote()) == 40
+
+
+def test_runtime_context_cluster(ray_cluster):
+    ray = ray_cluster
+
+    @ray.remote
+    def whoami():
+        from ray_tpu import get_runtime_context
+        return get_runtime_context().get_task_id()
+
+    assert ray.get(whoami.remote()) is not None
