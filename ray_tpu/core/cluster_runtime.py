@@ -69,6 +69,8 @@ class _ActorState:
         self.creation: Optional[dict] = None  # for owner-led restart
         self.lock = None  # asyncio.Lock, created lazily on the loop
         self.alive_event: Optional[object] = None
+        self.restart_inflight = False  # guards concurrent restart attempts
+        self.pinned_args: List[ObjectID] = []  # ctor-arg refs, pinned until DEAD
 
 
 class _LeasePool:
@@ -301,15 +303,17 @@ class ClusterRuntime:
             if kind == "inline":
                 return self._deserialize_payload(payload)
             # stored on some node; pull through the local raylet
-            res = self._loop.run(self._raylet.call(
-                "pull_object", oid=oid, owner_address=self.address,
-                timeout=None), timeout=timeout)
+            owner_addr = self.address
         else:
             owner = ref.owner_address
+            owner_addr = (owner.decode() if isinstance(owner, bytes)
+                          else owner)
+        try:
             res = self._loop.run(self._raylet.call(
-                "pull_object", oid=oid,
-                owner_address=owner.decode() if isinstance(owner, bytes)
-                else owner, timeout=None), timeout=timeout)
+                "pull_object", oid=oid, owner_address=owner_addr,
+                pull_timeout=timeout, timeout=None), timeout=timeout)
+        except concurrent.futures.TimeoutError:
+            raise GetTimeoutError(f"timed out fetching {ref}")
         if res is None:
             raise ObjectLostError(oid)
         if res.get("error"):
@@ -398,7 +402,7 @@ class ClusterRuntime:
         fn_key = self._fn.export(remote_function._function)
         streaming = opts.num_returns in ("streaming", "dynamic")
         num_returns = 1 if streaming else opts.num_returns
-        args_blob = serialization.serialize((args, kwargs)).to_bytes()
+        args_blob, pinned = self._serialize_args(args, kwargs)
         spec = {
             "task_id": task_id.hex(),
             "fn_key": fn_key,
@@ -419,35 +423,57 @@ class ClusterRuntime:
         if streaming:
             gen = ObjectRefGenerator()
             self._generators[task_id.hex()] = gen
-        self._loop.spawn(self._submit_async(spec, refs))
+        self._loop.spawn(self._submit_async(spec, refs, pinned))
         if streaming:
             return gen
         if opts.num_returns == 0:
             return None
         return refs[0] if opts.num_returns == 1 else refs
 
-    async def _submit_async(self, spec: dict, refs: List[ObjectRef]) -> None:
+    def _serialize_args(self, args, kwargs) -> Tuple[bytes, List[ObjectID]]:
+        """Serialize task arguments, pinning every contained ObjectRef so the
+        owner does not free it while the task spec is in flight (reference:
+        reference_count.h submitted-task counts)."""
+        pinned: List[ObjectID] = []
+        blob = serialization.serialize(
+            (args, kwargs),
+            ref_serializer=lambda r: pinned.append(r.id())).to_bytes()
+        for oid in pinned:
+            self.add_local_reference(oid)
+        return blob, pinned
+
+    def _unpin_args(self, pinned: List[ObjectID]) -> None:
+        for oid in pinned:
+            self.remove_local_reference(oid)
+
+    async def _submit_async(self, spec: dict, refs: List[ObjectRef],
+                            pinned: Optional[List[ObjectID]] = None) -> None:
         retries = spec.get("max_retries", 0)
         attempt = 0
-        while True:
-            try:
-                await self._run_on_leased_worker(spec)
-                return
-            except (ConnectionLost, RpcError) as e:
-                attempt += 1
-                if attempt > max(retries, 0):
-                    self._fail_task(spec, refs,
-                                    f"worker died ({e}); retries exhausted")
+        try:
+            while True:
+                try:
+                    await self._run_on_leased_worker(spec)
                     return
-                logger.info("retrying task %s (attempt %d): %s",
-                            spec["name"], attempt, e)
-                delay = ray_config().task_retry_delay_ms / 1000.0
-                if delay:
-                    import asyncio
-                    await asyncio.sleep(delay)
-            except Exception as e:  # noqa: BLE001
-                self._fail_task(spec, refs, f"submission failed: {e}")
-                return
+                except (ConnectionLost, RpcError) as e:
+                    attempt += 1
+                    if attempt > max(retries, 0):
+                        self._fail_task(
+                            spec, refs,
+                            f"worker died ({e}); retries exhausted")
+                        return
+                    logger.info("retrying task %s (attempt %d): %s",
+                                spec["name"], attempt, e)
+                    delay = ray_config().task_retry_delay_ms / 1000.0
+                    if delay:
+                        import asyncio
+                        await asyncio.sleep(delay)
+                except Exception as e:  # noqa: BLE001
+                    self._fail_task(spec, refs, f"submission failed: {e}")
+                    return
+        finally:
+            if pinned:
+                self._unpin_args(pinned)
 
     def _fail_task(self, spec: dict, refs: List[ObjectRef],
                    message: str) -> None:
@@ -605,7 +631,7 @@ class ClusterRuntime:
 
         state = _ActorState(aid)
         state.restarts_remaining = opts.max_restarts
-        args_blob = serialization.serialize((args, kwargs)).to_bytes()
+        args_blob, pinned = self._serialize_args(args, kwargs)
         state.creation = {
             "cls_key": cls_key,
             "args": args_blob,
@@ -615,10 +641,24 @@ class ClusterRuntime:
             "class_name": actor_class._class_name,
         }
         self._actors[aid] = state
+        # Constructor-arg refs stay pinned for the actor's whole life: a
+        # restart replays creation["args"], so they must survive until the
+        # actor is terminally DEAD (r2 review finding).
+        state.pinned_args = pinned
         self._actor_meta[aid] = (actor_class._class_name, meta)
-        self._loop.run(self._create_actor_async(state))
+        try:
+            self._loop.run(self._create_actor_async(state))
+        except BaseException:
+            self._unpin_actor(state)
+            raise
+        if state.state == "DEAD":
+            self._unpin_actor(state)
         return ActorHandle(actor_id, actor_class._class_name, meta,
                            runtime=self)
+
+    def _unpin_actor(self, state: _ActorState) -> None:
+        pinned, state.pinned_args = state.pinned_args, []
+        self._unpin_args(pinned)
 
     async def _create_actor_async(self, state: _ActorState) -> None:
         creation = state.creation
@@ -661,7 +701,7 @@ class ClusterRuntime:
         task_id = TaskID.for_actor_task(handle._ray_actor_id)
         streaming = opts.num_returns in ("streaming", "dynamic")
         num_returns = 1 if streaming else opts.num_returns
-        args_blob = serialization.serialize((args, kwargs)).to_bytes()
+        args_blob, pinned = self._serialize_args(args, kwargs)
         spec = {
             "task_id": task_id.hex(),
             "actor_id": aid,
@@ -681,7 +721,7 @@ class ClusterRuntime:
         if streaming:
             gen = ObjectRefGenerator()
             self._generators[task_id.hex()] = gen
-        self._loop.spawn(self._submit_actor_async(spec, refs))
+        self._loop.spawn(self._submit_actor_async(spec, refs, pinned))
         if streaming:
             return gen
         if opts.num_returns == 0:
@@ -716,8 +756,9 @@ class ClusterRuntime:
                     error_msg="timed out waiting for actor to become ALIVE")
         return await self._worker_client(state.address)
 
-    async def _submit_actor_async(self, spec: dict,
-                                  refs: List[ObjectRef]) -> None:
+    async def _submit_actor_async(self, spec: dict, refs: List[ObjectRef],
+                                  pinned: Optional[List[ObjectID]] = None
+                                  ) -> None:
         aid = spec["actor_id"]
         try:
             client = await self._actor_client(aid)
@@ -742,30 +783,45 @@ class ClusterRuntime:
         except Exception as e:  # noqa: BLE001
             self._fail_actor_task(
                 spec, refs, RayActorError(error_msg=str(e)))
+        finally:
+            if pinned:
+                self._unpin_args(pinned)
 
     async def _maybe_restart_actor(self, state: Optional[_ActorState]
                                    ) -> bool:
         """Owner-led actor restart (reference: GCS restarts up to
-        max_restarts, gcs_actor_manager.h RESTARTING)."""
-        if (state is None or state.creation is None
-                or state.restarts_remaining == 0):
-            if state is not None and state.creation is not None:
+        max_restarts, gcs_actor_manager.h RESTARTING). Guarded so concurrent
+        triggers (kill + in-flight ConnectionLost) run exactly one attempt."""
+        if state is None:
+            return False
+        if state.restart_inflight or state.state == "ALIVE":
+            return state.state == "ALIVE"
+        if state.creation is None or state.restarts_remaining == 0:
+            if state.creation is not None:
                 await self._gcs.update_actor(state.actor_id_hex, {
                     "state": "DEAD", "death_cause": "worker died"})
-                state.state = "DEAD"
+            state.state = "DEAD"
+            self._unpin_actor(state)
             return False
         import asyncio
-        if state.restarts_remaining > 0:
-            state.restarts_remaining -= 1
-        state.state = "RESTARTING"
-        await self._gcs.update_actor(state.actor_id_hex,
-                                     {"state": "RESTARTING"})
-        await asyncio.sleep(ray_config().actor_restart_backoff_ms / 1000.0)
+        state.restart_inflight = True
         try:
-            await self._create_actor_async(state)
+            if state.restarts_remaining > 0:
+                state.restarts_remaining -= 1
+            state.state = "RESTARTING"
+            await self._gcs.update_actor(state.actor_id_hex,
+                                         {"state": "RESTARTING"})
+            await asyncio.sleep(
+                ray_config().actor_restart_backoff_ms / 1000.0)
+            try:
+                await self._create_actor_async(state)
+            except Exception:
+                return False
+            if state.state == "DEAD":
+                self._unpin_actor(state)
             return state.state == "ALIVE"
-        except Exception:
-            return False
+        finally:
+            state.restart_inflight = False
 
     def _fail_actor_task(self, spec, refs, exc) -> None:
         blob = serialization.serialize_error(exc).to_bytes()
@@ -780,6 +836,11 @@ class ClusterRuntime:
     def kill_actor(self, handle, no_restart: bool = True) -> None:
         aid = handle._ray_actor_id.hex()
         state = self._actors.get(aid)
+        # ray.kill(no_restart=False) lets a restartable actor come back
+        # (reference: gcs_actor_manager destroys vs restarts on KillActor).
+        restartable = (not no_restart and state is not None
+                       and state.creation is not None
+                       and state.restarts_remaining != 0)
         if no_restart and state is not None:
             state.restarts_remaining = 0
             state.creation = None
@@ -787,8 +848,9 @@ class ClusterRuntime:
         async def _kill():
             try:
                 info = await self._gcs.get_actor(actor_id=aid)
-                await self._gcs.update_actor(aid, {
-                    "state": "DEAD", "death_cause": "ray.kill"})
+                if not restartable:
+                    await self._gcs.update_actor(aid, {
+                        "state": "DEAD", "death_cause": "ray.kill"})
                 if info and info.get("address"):
                     client = await self._worker_client(info["address"])
                     await client.notify("exit_worker")
@@ -796,8 +858,15 @@ class ClusterRuntime:
                 pass
 
         self._loop.run(_kill(), timeout=10)
-        if state is not None:
+        if state is None:
+            return
+        if restartable:
+            state.state = "RESTARTING"
+            state.address = None
+            self._loop.spawn(self._maybe_restart_actor(state))
+        else:
             state.state = "DEAD"
+            self._unpin_actor(state)
 
     def get_actor(self, name: str, namespace: Optional[str] = None):
         from ray_tpu.core.actor import ActorHandle
@@ -847,6 +916,16 @@ class ClusterRuntime:
         if gen is not None:
             gen._push(ObjectRef(ObjectID(bytes.fromhex(oid)),
                                 owner=self.address, runtime=self))
+        return True
+
+    async def handle_prune_object_location(self, conn: ServerConnection, *,
+                                           oid: str, node: str) -> bool:
+        """A raylet discovered `node` no longer holds `oid` (evicted): drop
+        the stale location from the owner-side directory."""
+        with self._owned_lock:
+            entry = self._owned.get(oid)
+            if entry is not None and node in entry.nodes:
+                entry.nodes.remove(node)
         return True
 
     async def handle_ping(self, conn: ServerConnection) -> str:

@@ -395,14 +395,18 @@ class Raylet:
 
     async def handle_pull_object(self, conn: ServerConnection, *, oid: str,
                                  owner_address: Optional[str],
-                                 pull_timeout: float = 30.0
+                                 pull_timeout: Optional[float] = 30.0
                                  ) -> Optional[Dict[str, Any]]:
         """Ensure `oid` is in the local store; returns shm info, inline
         payload, or None. Resolution order: local store -> owner's location
         directory (ownership-based object directory,
-        `ownership_based_object_directory.h`) -> remote raylet fetch."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        `ownership_based_object_directory.h`) -> remote raylet fetch.
+
+        pull_timeout=None blocks until the object materializes (a blocking
+        `ray.get` with no user timeout must not be capped server-side)."""
+        deadline = (None if pull_timeout is None
+                    else time.monotonic() + pull_timeout)
+        while deadline is None or time.monotonic() < deadline:
             info = self.store.info(oid)
             if info is not None:
                 return {"shm_name": info[0], "size": info[1]}
@@ -430,6 +434,14 @@ class Raylet:
                         self.store.put_bytes(oid, data)
                         info = self.store.info(oid)
                         return {"shm_name": info[0], "size": info[1]}
+                    # The node answered but no longer holds the object
+                    # (LRU-evicted/deleted): tell the owner to prune this
+                    # stale location so future pulls skip it.
+                    try:
+                        await owner.notify("prune_object_location",
+                                           oid=oid, node=node_addr)
+                    except Exception:
+                        pass
                 if not loc.get("pending"):
                     return {"error": "no reachable copy"}
             await asyncio.sleep(ray_config().object_timeout_ms / 1000.0)

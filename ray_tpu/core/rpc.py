@@ -14,6 +14,7 @@ Body (push):     {"push": channel, "d": data}   (server -> client only)
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import logging
 import struct
 import threading
@@ -285,9 +286,16 @@ class EventLoopThread:
         self.loop.run_forever()
 
     def run(self, coro: Awaitable, timeout: Optional[float] = None) -> Any:
-        """Run a coroutine on the loop from a sync thread, blocking."""
+        """Run a coroutine on the loop from a sync thread, blocking.
+
+        On timeout the in-flight coroutine is cancelled so it does not keep
+        running orphaned on the loop."""
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
-        return fut.result(timeout)
+        try:
+            return fut.result(timeout)
+        except concurrent.futures.TimeoutError:
+            fut.cancel()
+            raise
 
     def spawn(self, coro: Awaitable) -> None:
         asyncio.run_coroutine_threadsafe(coro, self.loop)

@@ -252,3 +252,63 @@ def test_runtime_context_cluster(ray_cluster):
         return get_runtime_context().get_task_id()
 
     assert ray.get(whoami.remote()) is not None
+
+
+def test_ref_in_task_args_pinned(ray_cluster):
+    """The canonical `ray.get(f.remote(ray.put(x)))`: the put ref's only
+    Python reference dies as soon as f.remote() returns, so the owner must
+    pin refs embedded in in-flight task specs (ADVICE r1 high: args were
+    serialized without a ref_serializer and freed mid-flight)."""
+    ray = ray_cluster
+
+    @ray.remote
+    def total(arr):
+        return float(arr.sum())
+
+    # Large enough to live in the shm store, not inline.
+    out = ray.get(total.remote(ray.put(np.ones(300000, dtype=np.float64))),
+                  timeout=60)
+    assert out == 300000.0
+
+
+def test_get_timeout_error_contract(ray_cluster):
+    """get(timeout=...) must raise GetTimeoutError (not a raw
+    concurrent.futures.TimeoutError) and a later get must still succeed."""
+    ray = ray_cluster
+
+    @ray.remote
+    def slow_big():
+        time.sleep(1.5)
+        return np.ones(300000, dtype=np.float64)  # > inline limit
+
+    ref = slow_big.remote()
+    with pytest.raises(ray.exceptions.GetTimeoutError):
+        ray.get(ref, timeout=0.2)
+    assert ray.get(ref, timeout=60).shape == (300000,)
+
+
+def test_kill_actor_restartable(ray_cluster):
+    """ray.kill(no_restart=False) on a restartable actor restarts it
+    (ADVICE r1 low: it used to be marked terminally DEAD)."""
+    ray = ray_cluster
+
+    @ray.remote(max_restarts=2)
+    class Phoenix:
+        def __init__(self):
+            self.pid = __import__("os").getpid()
+
+        def pid_of(self):
+            return self.pid
+
+    p = Phoenix.remote()
+    first = ray.get(p.pid_of.remote(), timeout=30)
+    ray.kill(p, no_restart=False)
+    deadline = time.time() + 30
+    second = None
+    while time.time() < deadline:
+        try:
+            second = ray.get(p.pid_of.remote(), timeout=10)
+            break
+        except ray.exceptions.RayActorError:
+            time.sleep(0.2)
+    assert second is not None and second != first

@@ -92,3 +92,18 @@ def test_node_death_detected(multi_node):
         time.sleep(0.3)
     else:
         pytest.fail("GCS never marked the killed node dead")
+
+
+def test_large_object_across_nodes(multi_node):
+    """Regression (VERDICT r1 #1): put -> get of a >1MB object across two
+    nodes; exercises raylet pull_object end to end."""
+    ray, cluster, nodes = multi_node
+
+    arr = np.arange(400000, dtype=np.float64)  # 3.2 MB
+    ref = ray.put(arr)
+
+    @ray.remote(resources={"worker_node": 0.5})
+    def checksum(a):
+        return float(a.sum())
+
+    assert ray.get(checksum.remote(ref), timeout=60) == float(arr.sum())
