@@ -1,0 +1,18 @@
+"""AIR-equivalent shared config/session layer.
+
+Reference: `python/ray/air/` — `ScalingConfig`/`RunConfig`/`FailureConfig`/
+`CheckpointConfig` (`air/config.py`), `session.report` (`air/session.py`),
+`Checkpoint` (`train/_checkpoint.py:55`). Redesigned TPU-first: ScalingConfig
+speaks in workers-per-slice/chips-per-worker rather than GPUs.
+"""
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                ScalingConfig)
+from ray_tpu.air.result import Result
+from ray_tpu.air import session
+
+__all__ = [
+    "Checkpoint", "CheckpointConfig", "FailureConfig", "RunConfig",
+    "ScalingConfig", "Result", "session",
+]

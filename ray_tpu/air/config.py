@@ -1,0 +1,85 @@
+"""Run/scaling configuration dataclasses.
+
+Reference: `python/ray/air/config.py` (ScalingConfig :157, RunConfig :599,
+FailureConfig :532, CheckpointConfig :458). TPU-first deltas: the unit of
+scaling is a *worker per TPU host* with `chips_per_worker`, and a
+`topology` field carries the slice type (e.g. "v5e-32") so gang placement
+can target one slice.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many training workers, and what each one holds.
+
+    num_workers: actors in the gang (one per TPU host for multi-host).
+    use_tpu: reserve TPU chips for each worker.
+    chips_per_worker: TPU chips each worker binds (4 for a v5e host).
+    topology: optional slice type label for slice-gang placement.
+    resources_per_worker: extra custom resources per worker.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 0
+    topology: Optional[str] = None
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    @property
+    def num_cpus_per_worker(self) -> float:
+        res = dict(self.resources_per_worker or {})
+        return float(res.get("CPU", 1.0))
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu and self.chips_per_worker:
+            res.setdefault("TPU", float(self.chips_per_worker))
+        return res
+
+    def as_placement_group_factory(self):
+        """Bundle list for gang placement (one bundle per worker)."""
+        return [self.worker_resources() for _ in range(self.num_workers)]
+
+
+@dataclass
+class FailureConfig:
+    """Reference: air/config.py FailureConfig — max_failures<0 = infinite."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Reference: air/config.py CheckpointConfig."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class RunConfig:
+    """Reference: air/config.py RunConfig (name, storage_path, failure/
+    checkpoint configs)."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(
+        default_factory=CheckpointConfig)
+    verbose: int = 1
+    log_to_file: bool = False
+    callbacks: Any = None
+
+    def resolved_storage_path(self) -> str:
+        return self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")

@@ -1,0 +1,112 @@
+"""Per-worker training session: the `session.report` surface.
+
+Reference: `python/ray/air/session.py` + `train/_internal/session.py:109,393`
+(_TrainSession with a result queue consumed by the backend executor).
+The session lives in the training worker process; `report()` hands a result
+to the executor and blocks until it is consumed, giving the gang natural
+lockstep at report boundaries.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+_session_lock = threading.Lock()
+_session: Optional["_TrainSession"] = None
+
+
+class _TrainSession:
+    def __init__(self, *, world_rank: int, local_rank: int, world_size: int,
+                 node_rank: int, trial_name: str = "",
+                 checkpoint: Optional[Checkpoint] = None,
+                 dataset_shard: Any = None):
+        self.world_rank = world_rank
+        self.local_rank = local_rank
+        self.world_size = world_size
+        self.node_rank = node_rank
+        self.trial_name = trial_name
+        self.loaded_checkpoint = checkpoint
+        self.dataset_shard = dataset_shard
+        # maxsize=1: report() blocks until the executor consumes the result
+        # (reference: session result queue semantics).
+        self.result_queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self.continue_event = threading.Event()
+        self.finished = False
+        self.error: Optional[BaseException] = None
+        self.final_return: Any = None
+        self.stop_requested = False
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        self.result_queue.put({"type": "report", "metrics": dict(metrics),
+                               "checkpoint": checkpoint})
+        self.continue_event.wait()
+        self.continue_event.clear()
+        if self.stop_requested:
+            raise _StopTraining()
+
+    def finish(self, final: Any = None,
+               error: Optional[BaseException] = None) -> None:
+        self.finished = True
+        self.error = error
+        self.final_return = final
+
+
+class _StopTraining(Exception):
+    """Raised inside the user loop when the controller stops the trial
+    (e.g. an early-stopping scheduler decision)."""
+
+
+def _set_session(s: Optional[_TrainSession]) -> None:
+    global _session
+    with _session_lock:
+        _session = s
+
+
+def _get_session(required: bool = True) -> Optional[_TrainSession]:
+    if _session is None and required:
+        raise RuntimeError(
+            "No training session active: session.* may only be called "
+            "inside train_loop_per_worker")
+    return _session
+
+
+# -- public API (reference: ray.air.session / ray.train free functions) ----
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    _get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return _get_session().loaded_checkpoint
+
+
+def get_world_rank() -> int:
+    return _get_session().world_rank
+
+
+def get_local_rank() -> int:
+    return _get_session().local_rank
+
+
+def get_world_size() -> int:
+    return _get_session().world_size
+
+
+def get_node_rank() -> int:
+    return _get_session().node_rank
+
+
+def get_trial_name() -> str:
+    return _get_session().trial_name
+
+
+def get_dataset_shard(name: str = "train") -> Any:
+    shard = _get_session().dataset_shard
+    if isinstance(shard, dict):
+        return shard.get(name)
+    return shard

@@ -136,9 +136,16 @@ class ClusterRuntime:
         self._actor_id_hex: Optional[str] = None
         self._shutdown = False
 
+        self._job_envs_applied: set = set()
         if mode == "driver":
+            import sys
+            # sys_path lets workers import driver-local modules (test files,
+            # scripts) so functions pickle by reference (reference:
+            # runtime-env working_dir / job_config code paths).
             self._loop.run(self._gcs.add_job(self.job_id.hex(), {
-                "driver_pid": os.getpid(), "namespace": self.namespace}))
+                "driver_pid": os.getpid(), "namespace": self.namespace,
+                "sys_path": [p for p in sys.path if p],
+                "cwd": os.getcwd()}))
 
     async def _async_start(self) -> None:
         await self._server.start()
@@ -292,6 +299,8 @@ class ClusterRuntime:
     def _fetch(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
         """Blocking fetch of one object's value."""
         oid = ref.hex()
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
         entry = None
         with self._owned_lock:
             entry = self._owned.get(oid)
@@ -308,10 +317,12 @@ class ClusterRuntime:
             owner = ref.owner_address
             owner_addr = (owner.decode() if isinstance(owner, bytes)
                           else owner)
+        remaining = (None if deadline is None
+                     else max(0.0, deadline - time.monotonic()))
         try:
             res = self._loop.run(self._raylet.call(
                 "pull_object", oid=oid, owner_address=owner_addr,
-                pull_timeout=timeout, timeout=None), timeout=timeout)
+                pull_timeout=remaining, timeout=None), timeout=remaining)
         except concurrent.futures.TimeoutError:
             raise GetTimeoutError(f"timed out fetching {ref}")
         if res is None:
@@ -405,6 +416,7 @@ class ClusterRuntime:
         args_blob, pinned = self._serialize_args(args, kwargs)
         spec = {
             "task_id": task_id.hex(),
+            "job_id": self.job_id.hex(),
             "fn_key": fn_key,
             "name": remote_function._function_name,
             "args": args_blob,
@@ -414,11 +426,7 @@ class ClusterRuntime:
             "resources": resource_demand(opts),
             "max_retries": opts.max_retries,
         }
-        refs = [ObjectRef(ObjectID.for_return(task_id, i + 1),
-                          owner=self.address, runtime=self)
-                for i in range(max(num_returns, 1))]
-        for r in refs:
-            self._owned_entry(r.hex())
+        refs = self._make_return_refs(task_id, num_returns)
         gen = None
         if streaming:
             gen = ObjectRefGenerator()
@@ -429,6 +437,18 @@ class ClusterRuntime:
         if opts.num_returns == 0:
             return None
         return refs[0] if opts.num_returns == 1 else refs
+
+    def _make_return_refs(self, task_id: TaskID,
+                          num_returns: int) -> List[ObjectRef]:
+        """Create owner entries BEFORE the ObjectRefs so each ref's
+        constructor registers a local reference (baseline refcount 1);
+        otherwise a later pin/unpin cycle can free a still-live ref."""
+        refs = []
+        for i in range(max(num_returns, 1)):
+            oid = ObjectID.for_return(task_id, i + 1)
+            self._owned_entry(oid.hex())
+            refs.append(ObjectRef(oid, owner=self.address, runtime=self))
+        return refs
 
     def _serialize_args(self, args, kwargs) -> Tuple[bytes, List[ObjectID]]:
         """Serialize task arguments, pinning every contained ObjectRef so the
@@ -669,7 +689,8 @@ class ClusterRuntime:
                 "actor_init", actor_id=state.actor_id_hex,
                 cls_key=creation["cls_key"], args=creation["args"],
                 max_concurrency=creation["max_concurrency"],
-                owner=self.address, timeout=120.0)
+                owner=self.address, job_id=self.job_id.hex(),
+                timeout=120.0)
         except Exception as e:
             await self._return_worker(worker, dead=True)
             await self._gcs.update_actor(state.actor_id_hex, {
@@ -704,6 +725,7 @@ class ClusterRuntime:
         args_blob, pinned = self._serialize_args(args, kwargs)
         spec = {
             "task_id": task_id.hex(),
+            "job_id": self.job_id.hex(),
             "actor_id": aid,
             "method": method_name,
             "name": f"{handle._class_name}.{method_name}",
@@ -712,11 +734,7 @@ class ClusterRuntime:
             "streaming": streaming,
             "owner": self.address,
         }
-        refs = [ObjectRef(ObjectID.for_return(task_id, i + 1),
-                          owner=self.address, runtime=self)
-                for i in range(max(num_returns, 1))]
-        for r in refs:
-            self._owned_entry(r.hex())
+        refs = self._make_return_refs(task_id, num_returns)
         gen = None
         if streaming:
             gen = ObjectRefGenerator()
@@ -816,7 +834,7 @@ class ClusterRuntime:
             try:
                 await self._create_actor_async(state)
             except Exception:
-                return False
+                state.state = "DEAD"
             if state.state == "DEAD":
                 self._unpin_actor(state)
             return state.state == "ALIVE"
@@ -935,6 +953,23 @@ class ClusterRuntime:
     # worker-mode execution (reference: core_worker.cc:2596 ExecuteTask +
     # _raylet.pyx task_execution_handler)
     # ==================================================================
+    def _ensure_job_env(self, job_id: Optional[str]) -> None:
+        """Extend sys.path with the driver's entries so driver-local modules
+        (test files, scripts) resolve when unpickling by reference."""
+        if not job_id or job_id in self._job_envs_applied:
+            return
+        self._job_envs_applied.add(job_id)
+        try:
+            info = self._loop.run(self._gcs.get_job(job_id), timeout=10)
+        except Exception:
+            return
+        if not info:
+            return
+        import sys
+        for p in info.get("sys_path", []):
+            if p not in sys.path:
+                sys.path.append(p)
+
     def _resolve_task_args(self, args_blob: bytes):
         args, kwargs = self._deserialize_payload(args_blob)
         args = [self.get(a) if isinstance(a, ObjectRef) else a for a in args]
@@ -967,6 +1002,7 @@ class ClusterRuntime:
         token = _set_task_context(
             task_id=TaskID(bytes.fromhex(task_id)))
         try:
+            self._ensure_job_env(spec.get("job_id"))
             fn = self._fn.fetch(spec["fn_key"])
             args, kwargs = self._resolve_task_args(spec["args"])
             value = fn(*args, **kwargs)
@@ -1027,6 +1063,7 @@ class ClusterRuntime:
 
         def run() -> Optional[bytes]:
             try:
+                self._ensure_job_env(spec.get("job_id"))
                 if actor:
                     method = getattr(self._actor_instance, spec["method"])
                     args, kwargs = self._resolve_task_args(spec["args"])
@@ -1068,7 +1105,8 @@ class ClusterRuntime:
     async def handle_actor_init(self, conn: ServerConnection, *,
                                 actor_id: str, cls_key: str, args: bytes,
                                 max_concurrency: Optional[int],
-                                owner: str) -> dict:
+                                owner: str,
+                                job_id: Optional[str] = None) -> dict:
         import asyncio
         import inspect as _inspect
 
@@ -1076,6 +1114,7 @@ class ClusterRuntime:
 
         def init() -> Optional[bytes]:
             try:
+                self._ensure_job_env(job_id)
                 cls = self._fn.fetch(cls_key)
                 rargs, rkwargs = self._resolve_task_args(args)
                 self._actor_instance = cls(*rargs, **rkwargs)
@@ -1116,6 +1155,7 @@ class ClusterRuntime:
             task_id=TaskID(bytes.fromhex(task_id)),
             actor_id=ActorID(bytes.fromhex(spec["actor_id"])))
         try:
+            self._ensure_job_env(spec.get("job_id"))
             method = getattr(self._actor_instance, spec["method"])
             args, kwargs = self._resolve_task_args(spec["args"])
             value = method(*args, **kwargs)

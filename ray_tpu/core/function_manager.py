@@ -19,6 +19,8 @@ def _hash_blob(blob: bytes) -> str:
     return hashlib.sha256(blob).hexdigest()[:32]
 
 
+
+
 class FunctionManager:
     def __init__(self, kv_put, kv_get):
         """kv_put(key: str, value: bytes, overwrite) / kv_get(key) -> bytes;

@@ -72,6 +72,9 @@ class GcsClient:
     async def add_job(self, job_id: str, info: Dict[str, Any]) -> None:
         await self.rpc.call("add_job", job_id=job_id, info=info)
 
+    async def get_job(self, job_id: str) -> Optional[Dict[str, Any]]:
+        return await self.rpc.call("get_job", job_id=job_id)
+
     async def mark_job_finished(self, job_id: str) -> None:
         await self.rpc.call("mark_job_finished", job_id=job_id)
 

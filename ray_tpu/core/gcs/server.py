@@ -235,6 +235,10 @@ class GcsServer:
                                  start_time=time.time())
         return True
 
+    async def handle_get_job(self, conn: ServerConnection, *,
+                             job_id: str) -> Optional[Dict[str, Any]]:
+        return self.jobs.get(job_id)
+
     async def handle_mark_job_finished(self, conn: ServerConnection, *,
                                        job_id: str) -> bool:
         if job_id in self.jobs:

@@ -1,0 +1,26 @@
+"""ray_tpu.train: distributed SPMD training on actor gangs.
+
+Reference: `python/ray/train/` — trainers over a BackendExecutor +
+WorkerGroup (`_internal/backend_executor.py:65`, `worker_group.py:102`).
+TPU-native: the process-group seam is `jax.distributed` + XLA collectives
+(`backend.py JaxConfig`) instead of torch NCCL.
+"""
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                ScalingConfig)
+from ray_tpu.air.result import Result
+from ray_tpu.air import session
+from ray_tpu.air.session import (get_checkpoint, get_dataset_shard,
+                                 get_local_rank, get_node_rank,
+                                 get_world_rank, get_world_size, report)
+from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig
+from ray_tpu.train.trainer import JaxTrainer, TrainingFailedError
+
+__all__ = [
+    "Backend", "BackendConfig", "Checkpoint", "CheckpointConfig",
+    "FailureConfig", "JaxConfig", "JaxTrainer", "Result", "RunConfig",
+    "ScalingConfig", "TrainingFailedError", "session", "report",
+    "get_checkpoint", "get_dataset_shard", "get_local_rank",
+    "get_node_rank", "get_world_rank", "get_world_size",
+]

@@ -1,0 +1,107 @@
+"""BackendExecutor: gang lifecycle + training-loop driver.
+
+Reference: `python/ray/train/_internal/backend_executor.py:65`
+(`start :124`, `start_training :438`). Orchestrates: spawn WorkerGroup ->
+backend.on_start (jax.distributed bootstrap) -> launch user loop on all
+workers -> poll results in lockstep -> surface gang failures.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.exceptions import RayActorError, RayTaskError
+from ray_tpu.train._internal.worker_group import WorkerGroup
+from ray_tpu.train.backend import BackendConfig
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingWorkerError(Exception):
+    """A worker of the gang failed; SPMD training requires whole-gang
+    restart (ICI collectives cannot survive member loss — SURVEY §7)."""
+
+
+class BackendExecutor:
+    def __init__(self, backend_config: BackendConfig,
+                 scaling_config: Optional[ScalingConfig] = None):
+        self._backend_config = backend_config
+        self._backend = backend_config.backend_cls()
+        self._scaling = scaling_config or ScalingConfig()
+        self.worker_group: Optional[WorkerGroup] = None
+
+    def start(self, placement_group=None) -> None:
+        self.worker_group = WorkerGroup(
+            self._scaling.num_workers,
+            self._scaling.worker_resources(),
+            placement_group=placement_group)
+        self._backend.on_start(self.worker_group, self._backend_config)
+
+    def start_training(self, train_fn: Callable, config: Optional[dict],
+                       *, trial_name: str = "", checkpoint=None,
+                       dataset_shards: Optional[List[Any]] = None) -> None:
+        wg = self.worker_group
+        assert wg is not None, "call start() first"
+        self._backend.on_training_start(wg, self._backend_config)
+        # rank bookkeeping: workers are already sorted by (node, pid)
+        node_order: List[str] = []
+        local_counts: Dict[str, int] = {}
+        refs = []
+        for i, w in enumerate(wg.workers):
+            node = wg.metadata[i]["node_id"]
+            if node not in node_order:
+                node_order.append(node)
+            local_rank = local_counts.get(node, 0)
+            local_counts[node] = local_rank + 1
+            shard = dataset_shards[i] if dataset_shards else None
+            refs.append(w.start_training.remote(
+                train_fn, config, world_rank=i, local_rank=local_rank,
+                world_size=len(wg), node_rank=node_order.index(node),
+                trial_name=trial_name, checkpoint=checkpoint,
+                dataset_shard=shard))
+        ray_tpu.get(refs, timeout=300)
+
+    def get_next_results(self) -> Optional[List[Dict[str, Any]]]:
+        """One lockstep round: every worker's next report (or None when all
+        workers finished). A dead/failed worker raises TrainingWorkerError."""
+        wg = self.worker_group
+        assert wg is not None
+        try:
+            results = ray_tpu.get(
+                [w.next_result.remote() for w in wg.workers])
+        except RayActorError as e:
+            raise TrainingWorkerError(f"training worker died: {e}") from e
+        except RayTaskError as e:
+            cause = e.cause if hasattr(e, "cause") else e
+            raise TrainingWorkerError(
+                f"training worker failed: {cause}") from e
+        done = [r for r in results if r.get("type") == "done"]
+        if len(done) == len(results):
+            return None
+        if done:
+            # Mixed finish/report: drive remaining workers to completion.
+            return [r for r in results if r.get("type") != "done"] or None
+        return results
+
+    def stop_training(self) -> None:
+        wg = self.worker_group
+        if wg is None:
+            return
+        for w in wg.workers:
+            try:
+                w.stop_training.remote()
+            except Exception:
+                pass
+
+    def shutdown(self) -> None:
+        if self.worker_group is not None:
+            try:
+                self._backend.on_shutdown(self.worker_group,
+                                          self._backend_config)
+            except Exception:
+                pass
+            self.worker_group.shutdown()
+            self.worker_group = None

@@ -1,0 +1,151 @@
+"""Training backends: the gang-wide process-group bootstrap seam.
+
+Reference: `python/ray/train/_internal/backend_executor.py` Backend hooks +
+`train/torch/config.py:151,171-190` where `_TorchBackend.on_start` wires
+MASTER_ADDR/PORT and `dist.init_process_group("nccl")`. The TPU-native
+replacement (`JaxConfig`) runs `jax.distributed.initialize(coordinator,
+num_processes, process_id)` on every worker, so XLA collectives ride
+ICI/DCN — no NCCL, no MASTER_ADDR.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+class Backend:
+    def on_start(self, worker_group, backend_config) -> None:
+        pass
+
+    def on_training_start(self, worker_group, backend_config) -> None:
+        pass
+
+    def on_shutdown(self, worker_group, backend_config) -> None:
+        pass
+
+
+@dataclass
+class BackendConfig:
+    @property
+    def backend_cls(self):
+        return Backend
+
+
+def _setup_jax_distributed(coordinator: str, world_size: int, rank: int,
+                           platform: Optional[str],
+                           cpu_devices_per_worker: Optional[int]) -> bool:
+    """Runs in each training worker BEFORE any JAX backend is touched."""
+    import os
+
+    if cpu_devices_per_worker and cpu_devices_per_worker > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{cpu_devices_per_worker}").strip()
+
+    import jax
+
+    if platform == "cpu" or (platform is None and not _has_tpu()):
+        # Cross-process CPU collectives need the gloo transport
+        # (the CPU analogue of the ICI fabric used on real slices).
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        # A preloaded jax may have pinned a different default platform
+        # regardless of JAX_PLATFORMS; the default backend decides
+        # process_count() inside jax array APIs, so pin it to cpu.
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu"
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=world_size, process_id=rank)
+    except RuntimeError as e:
+        if "already" in str(e).lower():
+            jax.distributed.shutdown()
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=world_size, process_id=rank)
+        else:
+            raise
+    # The process may have initialized device clients BEFORE distributed
+    # state existed (e.g. an eager import touching jax.devices()), freezing
+    # num_nodes=1. Drop them so the next backend lookup is rebuilt with the
+    # distributed world in place.
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if _xb.backends_are_initialized():
+            _xb._clear_backends()
+    except Exception:
+        pass
+    got = jax.process_count(platform)
+    assert got == world_size, f"jax world size {got} != {world_size}"
+    return True
+
+
+def _teardown_jax_distributed() -> bool:
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+    return True
+
+
+def _has_tpu() -> bool:
+    import os
+
+    return (os.environ.get("TPU_NAME") is not None
+            or os.path.exists("/dev/accel0")
+            or os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu"))
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """Backend config for JAX SPMD training.
+
+    platform: "cpu" to force the CPU backend (tests / CI without chips),
+        "tpu" for real slices, None = autodetect.
+    cpu_devices_per_worker: virtual host devices per worker process when
+        on CPU (`xla_force_host_platform_device_count`).
+    """
+
+    platform: Optional[str] = None
+    cpu_devices_per_worker: Optional[int] = None
+
+    @property
+    def backend_cls(self):
+        return _JaxBackend
+
+
+class _JaxBackend(Backend):
+    def on_start(self, worker_group, backend_config: JaxConfig) -> None:
+        coordinator = worker_group.execute_single(0, _free_port_on_worker)
+        n = len(worker_group)
+        import ray_tpu
+
+        refs = []
+        for rank, w in enumerate(worker_group.workers):
+            refs.append(w.execute.remote(
+                _setup_jax_distributed, coordinator, n, rank,
+                backend_config.platform,
+                backend_config.cpu_devices_per_worker))
+        ray_tpu.get(refs, timeout=300)
+
+    def on_shutdown(self, worker_group, backend_config: JaxConfig) -> None:
+        try:
+            worker_group.execute(_teardown_jax_distributed)
+        except Exception:
+            pass
+
+
+def _free_port_on_worker() -> str:
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"{socket.gethostbyname(socket.gethostname())}:{port}"

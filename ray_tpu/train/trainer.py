@@ -1,0 +1,158 @@
+"""JaxTrainer: data/model-parallel SPMD training on a gang of actors.
+
+Reference skeleton: `python/ray/train/base_trainer.py:579` (fit) +
+`data_parallel_trainer.py:416` (training_loop) — with the NCCL seam of
+`torch/config.py` replaced by `JaxConfig` (`jax.distributed` + XLA
+collectives). `fit()` runs the gang directly (and is reused by Tune as a
+trainable); failures restart the WHOLE gang from the latest checkpoint —
+SPMD collectives cannot survive member loss (SURVEY §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.air.result import Result
+from ray_tpu.train._internal.backend_executor import (BackendExecutor,
+                                                      TrainingWorkerError)
+from ray_tpu.train.backend import JaxConfig
+
+logger = logging.getLogger(__name__)
+
+
+class TrainingFailedError(RuntimeError):
+    """Training failed after exhausting FailureConfig.max_failures."""
+
+
+class JaxTrainer:
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[Dict[str, Any]] = None,
+                 jax_config: Optional[JaxConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        import cloudpickle
+
+        # Pre-pickled on the driver; workers resolve driver-local modules
+        # via the job sys_path (core_worker._ensure_job_env).
+        self._train_fn = cloudpickle.dumps(train_loop_per_worker)
+        self._train_config = train_loop_config
+        self._jax_config = jax_config or JaxConfig()
+        self._scaling = scaling_config or ScalingConfig()
+        self._run_config = run_config or RunConfig()
+        self._datasets = datasets or {}
+        self._resume_checkpoint = resume_from_checkpoint
+
+    # ------------------------------------------------------------------
+    def fit(self) -> Result:
+        run_name = self._run_config.name or f"JaxTrainer_{int(time.time())}"
+        exp_dir = os.path.join(self._run_config.resolved_storage_path(),
+                               run_name)
+        os.makedirs(exp_dir, exist_ok=True)
+
+        max_failures = self._run_config.failure_config.max_failures
+        failures = 0
+        checkpoint = self._resume_checkpoint
+        latest_ckpt: Optional[Checkpoint] = checkpoint
+        history: List[Dict[str, Any]] = []
+        ckpt_index = 0
+
+        while True:
+            executor = BackendExecutor(self._jax_config, self._scaling)
+            try:
+                executor.start()
+                executor.start_training(
+                    self._train_fn, self._train_config,
+                    trial_name=run_name, checkpoint=latest_ckpt,
+                    dataset_shards=self._dataset_shards())
+                while True:
+                    results = executor.get_next_results()
+                    if results is None:
+                        break
+                    metrics = results[0].get("metrics", {})
+                    history.append(metrics)
+                    self._append_result(exp_dir, metrics)
+                    ckpt = next((r.get("checkpoint") for r in results
+                                 if r.get("checkpoint") is not None), None)
+                    if ckpt is not None:
+                        latest_ckpt = self._persist_checkpoint(
+                            exp_dir, ckpt_index, ckpt)
+                        ckpt_index += 1
+                        self._prune_checkpoints(exp_dir)
+                last = history[-1] if history else {}
+                return Result(metrics=last, checkpoint=latest_ckpt,
+                              path=exp_dir, metrics_history=history)
+            except TrainingWorkerError as e:
+                failures += 1
+                retry = max_failures < 0 or failures <= max_failures
+                logger.warning(
+                    "training gang failed (%s); %s", e,
+                    "restarting from latest checkpoint" if retry
+                    else "failures exhausted")
+                if not retry:
+                    err = TrainingFailedError(str(e))
+                    return Result(metrics=history[-1] if history else {},
+                                  checkpoint=latest_ckpt, error=err,
+                                  path=exp_dir, metrics_history=history)
+            finally:
+                executor.shutdown()
+
+    # ------------------------------------------------------------------
+    def _dataset_shards(self) -> Optional[List[Any]]:
+        if not self._datasets:
+            return None
+        n = self._scaling.num_workers
+        shards: List[Any] = [dict() for _ in range(n)]
+        for name, ds in self._datasets.items():
+            if hasattr(ds, "split_for_workers"):
+                parts = ds.split_for_workers(n)
+            elif hasattr(ds, "split"):
+                parts = ds.split(n)
+            else:
+                parts = [ds] * n
+            for i in range(n):
+                shards[i][name] = parts[i]
+        return shards
+
+    def _persist_checkpoint(self, exp_dir: str, index: int,
+                            ckpt: Checkpoint) -> Checkpoint:
+        path = os.path.join(exp_dir, f"checkpoint_{index:06d}")
+        ckpt.to_directory(path)
+        return Checkpoint.from_directory(path)
+
+    def _prune_checkpoints(self, exp_dir: str) -> None:
+        keep = self._run_config.checkpoint_config.num_to_keep
+        if not keep:
+            return
+        import shutil
+
+        dirs = sorted(d for d in os.listdir(exp_dir)
+                      if d.startswith("checkpoint_"))
+        for d in dirs[:-keep]:
+            shutil.rmtree(os.path.join(exp_dir, d), ignore_errors=True)
+
+    def _append_result(self, exp_dir: str, metrics: Dict[str, Any]) -> None:
+        try:
+            with open(os.path.join(exp_dir, "result.json"), "a") as f:
+                f.write(json.dumps(metrics, default=str) + "\n")
+        except Exception:
+            pass
+
+    # -- Tune integration (reference: BaseTrainer.as_trainable) ---------
+    def as_trainable(self) -> Callable[[Optional[dict]], Result]:
+        def trainable(config: Optional[dict] = None) -> Result:
+            if config:
+                merged = dict(self._train_config or {})
+                merged.update(config)
+                self._train_config = merged
+            return self.fit()
+
+        trainable.__name__ = "JaxTrainer"
+        return trainable
