@@ -28,8 +28,10 @@ def main() -> None:
 
     backend = jax.default_backend()
     # GPT-small-class model; bf16 compute, fits a single v5e chip.
+    # head_dim 128 (= the MXU/lane width): the Pallas flash kernel runs ~3x
+    # faster than at head_dim 64, and every projection GEMM tiles cleanly.
     cfg = TransformerConfig(
-        vocab_size=32768, d_model=768, n_layers=12, n_heads=12, d_ff=2048,
+        vocab_size=32768, d_model=768, n_layers=12, n_heads=6, d_ff=2048,
         max_seq_len=1024, dtype=jnp.bfloat16, remat=True)
     batch, seq = (16, 1024) if backend == "tpu" else (2, 128)
 

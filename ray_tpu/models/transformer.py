@@ -66,10 +66,11 @@ def init_params(key, cfg: TransformerConfig) -> Params:
 
     keys = iter(jax.random.split(key, 16))
     layers: Dict[str, jax.Array] = {
+        # Q/K/V fused into ONE [d, 3, h] projection (a single MXU GEMM of
+        # [B*S, d] x [d, 3h] instead of three half-width ones); the packing
+        # dim stays unsharded so q/k/v unpack without resharding under tp.
         "ln1": jnp.ones((l, d), cfg.param_dtype),
-        "wq": norm(next(keys), (l, d, h), d),
-        "wk": norm(next(keys), (l, d, h), d),
-        "wv": norm(next(keys), (l, d, h), d),
+        "wqkv": norm(next(keys), (l, d, 3, h), d),
         "wo": norm(next(keys), (l, h, d), h),
         "ln2": jnp.ones((l, d), cfg.param_dtype),
     }
@@ -79,8 +80,8 @@ def init_params(key, cfg: TransformerConfig) -> Params:
         layers["moe_w1"] = norm(next(keys), (l, e, d, f), d)
         layers["moe_w2"] = norm(next(keys), (l, e, f, d), f)
     else:
-        layers["w1"] = norm(next(keys), (l, d, f), d)
-        layers["w3"] = norm(next(keys), (l, d, f), d)
+        # gate (w1) and up (w3) fused the same way: [d, 2, f].
+        layers["w13"] = norm(next(keys), (l, d, 2, f), d)
         layers["w2"] = norm(next(keys), (l, f, d), f)
     return {
         "embed": norm(next(keys), (v, d), d),
@@ -94,9 +95,7 @@ def param_specs(cfg: TransformerConfig) -> Params:
     layer-stack dim unsharded; experts over dp)."""
     layers: Dict[str, P] = {
         "ln1": P(None, None),
-        "wq": P(None, "dp", "tp"),
-        "wk": P(None, "dp", "tp"),
-        "wv": P(None, "dp", "tp"),
+        "wqkv": P(None, "dp", None, "tp"),
         "wo": P(None, "tp", "dp"),
         "ln2": P(None, None),
     }
@@ -105,8 +104,7 @@ def param_specs(cfg: TransformerConfig) -> Params:
         layers["moe_w1"] = P(None, "dp", None, "tp")
         layers["moe_w2"] = P(None, "dp", "tp", None)
     else:
-        layers["w1"] = P(None, "dp", "tp")
-        layers["w3"] = P(None, "dp", "tp")
+        layers["w13"] = P(None, "dp", None, "tp")
         layers["w2"] = P(None, "tp", "dp")
     return {
         "embed": P("tp", "dp"),
@@ -128,11 +126,15 @@ def _layer(x, lp, cfg: TransformerConfig, mesh, manual_sp, cos, sin,
 
     # -- attention block -----------------------------------------------
     y = _rmsnorm(x, lp["ln1"])
-    q = (y @ lp["wq"].astype(act)).reshape(b, s, h, hd)
-    k = (y @ lp["wk"].astype(act)).reshape(b, s, h, hd)
-    v = (y @ lp["wv"].astype(act)).reshape(b, s, h, hd)
-    q = apply_rotary(q, cos, sin, positions)
-    k = apply_rotary(k, cos, sin, positions)
+    qkv = jnp.einsum("bsd,dkh->kbsh", y, lp["wqkv"].astype(act))
+    q = qkv[0].reshape(b, s, h, hd)
+    k = qkv[1].reshape(b, s, h, hd)
+    v = qkv[2].reshape(b, s, h, hd)
+    # positions=None means "standard arange" — kept None through to
+    # attention() so the fused TPU flash kernel stays eligible.
+    pos = jnp.arange(s) if positions is None else positions
+    q = apply_rotary(q, cos, sin, pos)
+    k = apply_rotary(k, cos, sin, pos)
     if mesh is not None and not manual_sp:
         from jax.sharding import NamedSharding
         qkv_spec = NamedSharding(mesh, P("dp", "sp", "tp", None))
@@ -149,9 +151,8 @@ def _layer(x, lp, cfg: TransformerConfig, mesh, manual_sp, cos, sin,
                           top_k=cfg.moe_top_k,
                           capacity_factor=cfg.capacity_factor)
     else:
-        gate = jax.nn.silu(y @ lp["w1"].astype(act))
-        up = y @ lp["w3"].astype(act)
-        ff = (gate * up) @ lp["w2"].astype(act)
+        gu = jnp.einsum("bsd,dkf->kbsf", y, lp["w13"].astype(act))
+        ff = (jax.nn.silu(gu[0]) * gu[1]) @ lp["w2"].astype(act)
         aux = jnp.zeros((), jnp.float32)
     x = x + ff
     if mesh is not None and not manual_sp:
@@ -172,8 +173,6 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
         x = jax.lax.with_sharding_constraint(
             x, NamedSharding(mesh, P("dp", "sp", None)))
     cos, sin = rotary_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
-    if positions is None:
-        positions = jnp.arange(tokens.shape[1])
 
     def scan_body(carry, lp):
         fn = _layer
@@ -184,8 +183,11 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
 
     x, auxes = jax.lax.scan(scan_body, x, params["layers"])
     x = _rmsnorm(x, params["ln_f"])
-    logits = x @ params["embed"].T.astype(act)  # tied embeddings
-    return logits.astype(jnp.float32), jnp.sum(auxes)
+    # Tied embeddings. Logits stay in the compute dtype (bf16 on TPU): the
+    # loss upcasts inside its reductions, so the [B,S,V] float32 array the
+    # old code materialized (2 GB at B=16,S=1024,V=32k) never exists.
+    logits = x @ params["embed"].T.astype(act)
+    return logits, jnp.sum(auxes)
 
 
 def to_pipelined(params: Params, n_stages: int) -> Params:
@@ -241,12 +243,17 @@ def forward_pipelined(params: Params, tokens: jax.Array,
                    num_microbatches=num_microbatches)
     x = _rmsnorm(x, params["ln_f"])
     logits = x @ params["embed"].T.astype(act)
-    return logits.astype(jnp.float32), aux
+    return logits, aux
 
 
 def _token_nll(logits, targets, mask=None) -> jax.Array:
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    """Fused next-token NLL: logsumexp + target-logit gather, accumulated in
+    float32. Unlike log_softmax→gather this never materializes a [B,S,V]
+    float32 intermediate — XLA fuses the upcast into the reductions, so the
+    logits are read from HBM in their compute dtype."""
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)   # [B,S]
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = lse - tgt.astype(jnp.float32)
     if mask is None:
         return jnp.mean(nll)
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)

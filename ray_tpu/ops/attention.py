@@ -25,6 +25,40 @@ from jax.sharding import PartitionSpec as P
 _NEG_INF = -1e30
 
 
+def flash_attention_tpu(q, k, v, *, causal: bool = True,
+                        block: int = 512):
+    """Fused flash attention on TPU via the Pallas MHA kernel shipped with
+    JAX (jax.experimental.pallas.ops.tpu.flash_attention) — O(S) memory, no
+    materialized [B,H,S,S] score matrix, differentiable (custom VJP).
+
+    q/k/v: [B, S, H, D] (we transpose to the kernel's [B, H, S, D]).
+    """
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention)
+
+    s = q.shape[1]
+    # Largest lane-aligned block that divides S (kernel requires s % blk == 0).
+    blk = next(b for b in (block, 384, 256, 128) if b <= s and s % b == 0)
+    sizes = BlockSizes(
+        block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
+        block_q_major_dkv=blk, block_k_major_dkv=blk, block_k_dkv=blk,
+        block_q_dkv=blk, block_k_major_dq=blk, block_k_dq=blk,
+        block_q_dq=blk)
+    qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    o = flash_attention(qt, kt, vt, causal=causal,
+                        sm_scale=q.shape[-1] ** -0.5, block_sizes=sizes)
+    return o.transpose(0, 2, 1, 3)
+
+
+def _flash_eligible(q) -> bool:
+    """Flash kernel needs the TPU backend, a lane-aligned head_dim, and a
+    sequence long enough to tile (standard arange positions only)."""
+    s, d = q.shape[1], q.shape[3]
+    return (jax.default_backend() == "tpu"
+            and (d % 128 == 0 or d == 64)   # kernel handles 64 natively
+            and s % 128 == 0)
+
+
 def plain_attention(q, k, v, *, causal: bool = True, positions=None):
     """Softmax attention. q/k/v: [B, S, H, D]; positions: [S] global indices
     for the causal mask (defaults to arange)."""
@@ -119,4 +153,12 @@ def attention(q, k, v, *, causal: bool = True, mesh=None,
             and mesh.shape[sp_axis] > 1:
         return ring_attention(q, k, v, mesh=mesh, axis_name=sp_axis,
                               causal=causal, positions=positions)
+    # positions=None means standard arange — exactly what the fused TPU
+    # kernel's causal mask implements. Single-chip only: a pallas_call has
+    # no SPMD partitioning rule, so under a >1-device mesh (dp/tp sharded
+    # q/k/v) we stay on the XLA path instead of forcing an all-gather.
+    unsharded = mesh is None or all(
+        mesh.shape[a] == 1 for a in mesh.axis_names)
+    if positions is None and causal and unsharded and _flash_eligible(q):
+        return flash_attention_tpu(q, k, v, causal=True)
     return plain_attention(q, k, v, causal=causal, positions=positions)
