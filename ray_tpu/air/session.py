@@ -51,9 +51,12 @@ class _TrainSession:
 
     def finish(self, final: Any = None,
                error: Optional[BaseException] = None) -> None:
-        self.finished = True
+        # `finished` is polled from another thread: it must be the LAST
+        # write, or a poller can observe finished=True with error unset and
+        # report a crashed loop as a clean finish.
         self.error = error
         self.final_return = final
+        self.finished = True
 
 
 class _StopTraining(Exception):

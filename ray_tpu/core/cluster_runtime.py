@@ -137,6 +137,7 @@ class ClusterRuntime:
         self._shutdown = False
 
         self._job_envs_applied: set = set()
+        self._job_env_lock = threading.Lock()
         if mode == "driver":
             import sys
             # sys_path lets workers import driver-local modules (test files,
@@ -866,7 +867,13 @@ class ClusterRuntime:
         async def _kill():
             try:
                 info = await self._gcs.get_actor(actor_id=aid)
-                if not restartable:
+                if restartable:
+                    # Publish RESTARTING before the worker exits so borrowers
+                    # never resolve the stale ALIVE address of a dead worker
+                    # during the kill->restart window.
+                    await self._gcs.update_actor(aid, {
+                        "state": "RESTARTING", "address": None})
+                else:
                     await self._gcs.update_actor(aid, {
                         "state": "DEAD", "death_cause": "ray.kill"})
                 if info and info.get("address"):
@@ -958,17 +965,20 @@ class ClusterRuntime:
         (test files, scripts) resolve when unpickling by reference."""
         if not job_id or job_id in self._job_envs_applied:
             return
-        self._job_envs_applied.add(job_id)
         try:
             info = self._loop.run(self._gcs.get_job(job_id), timeout=10)
         except Exception:
-            return
-        if not info:
-            return
+            return  # transient GCS error: leave unmarked so we retry
         import sys
-        for p in info.get("sys_path", []):
-            if p not in sys.path:
-                sys.path.append(p)
+        with self._job_env_lock:
+            if job_id in self._job_envs_applied:
+                return
+            for p in (info or {}).get("sys_path", []):
+                if p not in sys.path:
+                    sys.path.append(p)
+            # A falsy record is memoized too: the job is simply gone from
+            # the GCS table and won't come back, so don't re-query per task.
+            self._job_envs_applied.add(job_id)
 
     def _resolve_task_args(self, args_blob: bytes):
         args, kwargs = self._deserialize_payload(args_blob)

@@ -13,7 +13,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.air.config import ScalingConfig
-from ray_tpu.exceptions import RayActorError, RayTaskError
+from ray_tpu.exceptions import GetTimeoutError, RayActorError, RayTaskError
 from ray_tpu.train._internal.worker_group import WorkerGroup
 from ray_tpu.train.backend import BackendConfig
 
@@ -64,20 +64,37 @@ class BackendExecutor:
                 dataset_shard=shard))
         ray_tpu.get(refs, timeout=300)
 
-    def get_next_results(self) -> Optional[List[Dict[str, Any]]]:
+    def get_next_results(
+            self, liveness_interval_s: float = 30.0,
+    ) -> Optional[List[Dict[str, Any]]]:
         """One lockstep round: every worker's next report (or None when all
-        workers finished). A dead/failed worker raises TrainingWorkerError."""
+        workers finished). A dead/failed worker raises TrainingWorkerError.
+
+        Results are polled with a bounded timeout: survivors of a gang
+        member's death can be blocked inside an XLA/gloo collective and
+        never return their `next_result`, so each timeout window we probe
+        worker liveness with a cheap actor call — a dead peer converts the
+        hang into a gang restart instead of a driver deadlock."""
         wg = self.worker_group
         assert wg is not None
-        try:
-            results = ray_tpu.get(
-                [w.next_result.remote() for w in wg.workers])
-        except RayActorError as e:
-            raise TrainingWorkerError(f"training worker died: {e}") from e
-        except RayTaskError as e:
-            cause = e.cause if hasattr(e, "cause") else e
-            raise TrainingWorkerError(
-                f"training worker failed: {cause}") from e
+        refs = [w.next_result.remote() for w in wg.workers]
+        while True:
+            try:
+                results = ray_tpu.get(refs, timeout=liveness_interval_s)
+                break
+            except GetTimeoutError:
+                self._probe_worker_liveness()
+            except RayActorError as e:
+                raise TrainingWorkerError(
+                    f"training worker died: {e}") from e
+            except RayTaskError as e:
+                cause = e.cause if hasattr(e, "cause") else e
+                raise TrainingWorkerError(
+                    f"training worker failed: {cause}") from e
+        # Tag each result with its world rank (workers are rank-ordered) so
+        # callers can pick rank 0 even on mixed finish/report rounds.
+        for rank, r in enumerate(results):
+            r.setdefault("world_rank", rank)
         done = [r for r in results if r.get("type") == "done"]
         if len(done) == len(results):
             return None
@@ -85,6 +102,25 @@ class BackendExecutor:
             # Mixed finish/report: drive remaining workers to completion.
             return [r for r in results if r.get("type") != "done"] or None
         return results
+
+    def _probe_worker_liveness(self) -> None:
+        """Ping every worker actor; a dead one raises TrainingWorkerError.
+
+        Pings are checked per-ref: a batched get fetches sequentially
+        against one deadline, so a frozen (but live) worker early in the
+        list would mask a dead worker behind it."""
+        wg = self.worker_group
+        assert wg is not None
+        pings = [w.ping.remote() for w in wg.workers]
+        for rank, ref in enumerate(pings):
+            try:
+                ray_tpu.get(ref, timeout=10)
+            except GetTimeoutError:
+                continue  # slow but not provably dead; keep waiting
+            except (RayActorError, RayTaskError) as e:
+                raise TrainingWorkerError(
+                    f"training worker {rank} died mid-collective: {e}"
+                ) from e
 
     def stop_training(self) -> None:
         wg = self.worker_group

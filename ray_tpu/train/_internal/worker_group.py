@@ -37,6 +37,10 @@ class TrainWorker:
         """Run an arbitrary function in the worker process (backend hooks)."""
         return fn(*args, **kwargs)
 
+    def ping(self) -> bool:
+        """Liveness probe used by the executor while results are pending."""
+        return True
+
     def free_port(self) -> str:
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.bind(("", 0))

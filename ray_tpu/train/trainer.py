@@ -76,7 +76,11 @@ class JaxTrainer:
                     results = executor.get_next_results()
                     if results is None:
                         break
-                    metrics = results[0].get("metrics", {})
+                    # Rank 0's report (lowest surviving rank on mixed-done
+                    # rounds) is the canonical metrics source.
+                    lead = min(results,
+                               key=lambda r: r.get("world_rank", 1 << 30))
+                    metrics = lead.get("metrics", {})
                     history.append(metrics)
                     self._append_result(exp_dir, metrics)
                     ckpt = next((r.get("checkpoint") for r in results
