@@ -28,6 +28,7 @@ from ray_tpu.core.worker import (
 )
 from ray_tpu.runtime_context import get_runtime_context
 from ray_tpu import exceptions
+from ray_tpu import util
 
 __version__ = "0.1.0"
 
@@ -36,5 +37,5 @@ __all__ = [
     "RemoteFunction", "remote", "method", "init", "shutdown",
     "is_initialized", "get", "put", "wait", "kill", "cancel", "get_actor",
     "nodes", "cluster_resources", "available_resources", "timeline",
-    "get_runtime_context", "exceptions", "__version__",
+    "get_runtime_context", "exceptions", "util", "__version__",
 ]

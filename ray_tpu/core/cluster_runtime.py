@@ -46,6 +46,21 @@ logger = logging.getLogger(__name__)
 INLINE_LIMIT_KEY = "max_direct_call_object_size"
 
 
+def _pg_id_of(pg: Any) -> Optional[str]:
+    """Normalize a placement-group option value (PlacementGroup object or
+    hex id string) to the hex id, or None."""
+    if pg is None:
+        return None
+    if isinstance(pg, str):
+        return pg
+    pid = getattr(pg, "id", None)
+    if isinstance(pid, str):
+        return pid
+    if pid is not None and hasattr(pid, "hex"):
+        return pid.hex()
+    raise ValueError(f"invalid placement_group option: {pg!r}")
+
+
 class _Owned:
     """Owner-side record of one object (reference: reference_count.h entry +
     memory-store slot)."""
@@ -138,6 +153,8 @@ class ClusterRuntime:
 
         self._job_envs_applied: set = set()
         self._job_env_lock = threading.Lock()
+        self._pg_cache: Dict[str, dict] = {}
+        self._pg_rr: Dict[str, int] = {}
         if mode == "driver":
             import sys
             # sys_path lets workers import driver-local modules (test files,
@@ -427,6 +444,13 @@ class ClusterRuntime:
             "resources": resource_demand(opts),
             "max_retries": opts.max_retries,
         }
+        pg = _pg_id_of(getattr(opts, "placement_group", None))
+        if pg is not None:
+            spec["pg"] = {
+                "pg_id": pg,
+                "bundle_index": getattr(
+                    opts, "placement_group_bundle_index", -1),
+            }
         refs = self._make_return_refs(task_id, num_returns)
         gen = None
         if streaming:
@@ -512,8 +536,13 @@ class ClusterRuntime:
             gen._finish(WCE(f"task {spec['name']}: {message}"))
 
     async def _run_on_leased_worker(self, spec: dict) -> None:
-        key = f"{spec['fn_key']}:{sorted(spec['resources'].items())}"
-        worker = await self._acquire_worker(key, spec["resources"])
+        pg = spec.get("pg")
+        key = (f"{spec['fn_key']}:{sorted(spec['resources'].items())}"
+               f":{pg['pg_id']}:{pg['bundle_index']}" if pg else
+               f"{spec['fn_key']}:{sorted(spec['resources'].items())}")
+        worker = await self._acquire_worker(key, spec["resources"], pg=pg)
+        if worker.get("chip_ids"):
+            spec = dict(spec, visible_chips=worker["chip_ids"])
         try:
             client = await self._worker_client(worker["worker_address"])
             reply = await client.call("push_task", spec=spec, timeout=None)
@@ -550,22 +579,32 @@ class ClusterRuntime:
                     gen._finish()
 
     # -- lease pool ----------------------------------------------------
-    async def _acquire_worker(self, key: str,
-                              resources: Dict[str, float]) -> dict:
+    async def _acquire_worker(self, key: str, resources: Dict[str, float],
+                              pg: Optional[dict] = None) -> dict:
         pool = self._lease_pools.setdefault(key, _LeasePool())
         if pool.idle:
             return pool.idle.pop()
-        return await self._request_lease(resources)
+        bundle = None
+        address = None
+        if pg is not None:
+            address, idx = await self._pg_location(
+                pg["pg_id"], pg["bundle_index"], demand=resources)
+            bundle = (pg["pg_id"], idx)
+        return await self._request_lease(resources, bundle=bundle,
+                                         address=address)
 
     async def _request_lease(self, resources: Dict[str, float],
-                             is_actor: bool = False) -> dict:
-        address = self.raylet_address
+                             is_actor: bool = False,
+                             bundle: Optional[Tuple[str, int]] = None,
+                             address: Optional[str] = None) -> dict:
+        address = address or self.raylet_address
         spillbacks = 0
         while True:
             client = await self._raylet_client(address)
             reply = await client.call(
                 "request_worker_lease", resources=resources,
                 is_actor=is_actor, spillback_count=spillbacks,
+                bundle=list(bundle) if bundle else None,
                 timeout=ray_config().worker_lease_timeout_ms / 1000.0)
             if reply.get("granted"):
                 info = reply["granted"]
@@ -660,6 +699,11 @@ class ClusterRuntime:
             "release_after_start": {} if running_demand else demand,
             "max_concurrency": opts.max_concurrency,
             "class_name": actor_class._class_name,
+            "pg": ({"pg_id": _pg_id_of(opts.placement_group),
+                    "bundle_index": getattr(
+                        opts, "placement_group_bundle_index", -1)}
+                   if getattr(opts, "placement_group", None) is not None
+                   else None),
         }
         self._actors[aid] = state
         # Constructor-arg refs stay pinned for the actor's whole life: a
@@ -683,7 +727,15 @@ class ClusterRuntime:
 
     async def _create_actor_async(self, state: _ActorState) -> None:
         creation = state.creation
-        worker = await self._request_lease(creation["demand"], is_actor=True)
+        pg = creation.get("pg")
+        bundle = None
+        address = None
+        if pg is not None:
+            address, idx = await self._pg_location(
+                pg["pg_id"], pg["bundle_index"], demand=creation["demand"])
+            bundle = (pg["pg_id"], idx)
+        worker = await self._request_lease(creation["demand"], is_actor=True,
+                                           bundle=bundle, address=address)
         client = await self._worker_client(worker["worker_address"])
         try:
             reply = await client.call(
@@ -691,6 +743,7 @@ class ClusterRuntime:
                 cls_key=creation["cls_key"], args=creation["args"],
                 max_concurrency=creation["max_concurrency"],
                 owner=self.address, job_id=self.job_id.hex(),
+                visible_chips=worker.get("chip_ids") or None,
                 timeout=120.0)
         except Exception as e:
             await self._return_worker(worker, dead=True)
@@ -910,6 +963,186 @@ class ClusterRuntime:
         pass
 
     # ==================================================================
+    # placement groups (reference: python/ray/util/placement_group.py:41 +
+    # gcs_placement_group_scheduler.h 2PC; owner-led here, like actors)
+    # ==================================================================
+    def create_placement_group(self, bundles: List[Dict[str, float]],
+                               strategy: str = "PACK", name: str = "",
+                               target_node_ids: Optional[List[str]] = None
+                               ) -> str:
+        from ray_tpu.core.ids import PlacementGroupID
+        from ray_tpu.core.pg_scheduler import VALID_STRATEGIES
+
+        if strategy not in VALID_STRATEGIES:
+            raise ValueError(f"Invalid placement strategy {strategy!r}; "
+                             f"valid: {VALID_STRATEGIES}")
+        if not bundles or any(not b for b in bundles):
+            raise ValueError("placement group requires non-empty bundles")
+        pg_id = PlacementGroupID.of(self.job_id).hex()
+        info = {
+            "bundles": [dict(b) for b in bundles],
+            "strategy": strategy,
+            "name": name,
+            "state": "PENDING",
+            "owner": self.address,
+            "target_node_ids": target_node_ids,
+        }
+        self._loop.run(self._gcs.register_placement_group(pg_id, info))
+        self._loop.spawn(self._schedule_pg_async(pg_id, info))
+        return pg_id
+
+    async def _schedule_pg_async(self, pg_id: str, info: dict) -> None:
+        import asyncio
+
+        from ray_tpu.core.pg_scheduler import select_pg_nodes
+
+        bundles = info["bundles"]
+        detail = "no feasible placement"
+        for attempt in range(8):
+            try:
+                # The user may have removed the group while we were
+                # retrying; never resurrect it.
+                current = await self._gcs.get_placement_group(pg_id)
+                if (current or {}).get("state") != "PENDING":
+                    return
+                nodes = [n for n in await self._gcs.get_nodes()
+                         if n.get("alive")]
+                placement = select_pg_nodes(bundles, nodes,
+                                            info["strategy"],
+                                            info.get("target_node_ids"))
+                if placement is None:
+                    await asyncio.sleep(0.25 * (attempt + 1))
+                    continue
+                prepared: List[Tuple[int, dict]] = []
+                failure = None
+                for idx, node in enumerate(placement):
+                    client = await self._raylet_client(node["address"])
+                    r = await client.call(
+                        "prepare_bundle", pg_id=pg_id, bundle_index=idx,
+                        resources=bundles[idx], timeout=10.0)
+                    if not r.get("ok"):
+                        failure = r.get("reason", "prepare rejected")
+                        break
+                    prepared.append((idx, node))
+                if failure is not None:
+                    detail = failure
+                    for idx, node in prepared:
+                        client = await self._raylet_client(node["address"])
+                        await client.call("return_bundle", pg_id=pg_id,
+                                          bundle_index=idx, timeout=10.0)
+                    await asyncio.sleep(0.25 * (attempt + 1))
+                    continue
+                for idx, node in prepared:
+                    client = await self._raylet_client(node["address"])
+                    await client.call("commit_bundle", pg_id=pg_id,
+                                      bundle_index=idx, timeout=10.0)
+                # CAS on PENDING: if a concurrent remove won, roll the
+                # committed bundles back instead of resurrecting the PG.
+                ok = await self._gcs.update_placement_group(pg_id, {
+                    "state": "CREATED",
+                    "bundle_locations": [
+                        {"node_id": n["node_id"], "address": n["address"]}
+                        for n in placement],
+                }, expect_state="PENDING")
+                if not ok:
+                    for idx, node in prepared:
+                        client = await self._raylet_client(node["address"])
+                        await client.call("return_bundle", pg_id=pg_id,
+                                          bundle_index=idx, timeout=10.0)
+                return
+            except Exception as e:  # noqa: BLE001
+                detail = str(e)
+                await asyncio.sleep(0.25 * (attempt + 1))
+        await self._gcs.update_placement_group(
+            pg_id, {"state": "INFEASIBLE", "detail": detail},
+            expect_state="PENDING")
+
+    def placement_group_wait(self, pg_id: str,
+                             timeout: Optional[float] = None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            info = self._loop.run(self._gcs.get_placement_group(pg_id))
+            state = (info or {}).get("state")
+            if state == "CREATED":
+                return True
+            if state in ("INFEASIBLE", "REMOVED", None):
+                return False
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.05)
+
+    def remove_placement_group(self, pg_id: str) -> None:
+        info = self._loop.run(self._gcs.get_placement_group(pg_id))
+        if info is None or info.get("state") == "REMOVED":
+            return
+
+        async def _remove():
+            for idx, loc in enumerate(info.get("bundle_locations") or []):
+                try:
+                    client = await self._raylet_client(loc["address"])
+                    await client.call("return_bundle", pg_id=pg_id,
+                                      bundle_index=idx, timeout=10.0)
+                except Exception:
+                    pass
+            await self._gcs.update_placement_group(
+                pg_id, {"state": "REMOVED"})
+
+        self._loop.run(_remove(), timeout=30)
+        self._pg_cache.pop(pg_id, None)
+
+    def placement_group_table(self, pg_id: Optional[str] = None):
+        if pg_id is not None:
+            return self._loop.run(self._gcs.get_placement_group(pg_id))
+        return {p["pg_id"]: p
+                for p in self._loop.run(self._gcs.list_placement_groups())}
+
+    async def _pg_location(self, pg_id: str, bundle_index: int,
+                           demand: Optional[Dict[str, float]] = None
+                           ) -> Tuple[str, int]:
+        """Resolve (raylet_address, bundle_index) for a lease against a PG,
+        waiting for a still-scheduling group. bundle_index -1 → round-robin
+        over the bundles whose spec can hold `demand` (reference:
+        any-feasible-bundle semantics)."""
+        import asyncio
+
+        info = self._pg_cache.get(pg_id)
+        if info is None or info.get("state") != "CREATED":
+            deadline = time.monotonic() + 60.0
+            while True:
+                info = await self._gcs.get_placement_group(pg_id)
+                state = (info or {}).get("state")
+                if state == "CREATED":
+                    self._pg_cache[pg_id] = info
+                    break
+                if state in ("REMOVED", "INFEASIBLE", None):
+                    raise ValueError(
+                        f"placement group {pg_id} is unusable "
+                        f"(state={state}: "
+                        f"{(info or {}).get('detail', '')})")
+                if time.monotonic() >= deadline:
+                    raise ValueError(
+                        f"placement group {pg_id} not ready within 60s")
+                await asyncio.sleep(0.05)
+        locs = info["bundle_locations"]
+        if bundle_index is None or bundle_index < 0:
+            specs = info.get("bundles", [])
+            feasible = [i for i in range(len(locs))
+                        if not demand or not specs
+                        or all(specs[i].get(k, 0.0) + 1e-9 >= v
+                               for k, v in demand.items())]
+            if not feasible:
+                raise ValueError(
+                    f"no bundle of placement group {pg_id} can hold "
+                    f"{demand}; bundles: {specs}")
+            self._pg_rr[pg_id] = self._pg_rr.get(pg_id, -1) + 1
+            bundle_index = feasible[self._pg_rr[pg_id] % len(feasible)]
+        if bundle_index >= len(locs):
+            raise ValueError(
+                f"bundle index {bundle_index} out of range for placement "
+                f"group with {len(locs)} bundles")
+        return locs[bundle_index]["address"], bundle_index
+
+    # ==================================================================
     # owner-side RPC service (reference: CoreWorkerService pubsub/locations)
     # ==================================================================
     async def handle_get_object_locations(self, conn: ServerConnection, *,
@@ -1012,6 +1245,7 @@ class ClusterRuntime:
         token = _set_task_context(
             task_id=TaskID(bytes.fromhex(task_id)))
         try:
+            self._apply_visible_chips(spec.get("visible_chips"))
             self._ensure_job_env(spec.get("job_id"))
             fn = self._fn.fetch(spec["fn_key"])
             args, kwargs = self._resolve_task_args(spec["args"])
@@ -1112,11 +1346,20 @@ class ClusterRuntime:
                           node=res.get("node"), timeout=30.0)
 
     # -- actor execution -----------------------------------------------
+    def _apply_visible_chips(self, chips) -> None:
+        """Isolate this worker process to its granted TPU chips (reference:
+        accelerators/tpu.py:214). Must run before user code imports jax."""
+        if chips:
+            from ray_tpu.parallel.tpu import visible_chip_env
+
+            os.environ.update(visible_chip_env(chips))
+
     async def handle_actor_init(self, conn: ServerConnection, *,
                                 actor_id: str, cls_key: str, args: bytes,
                                 max_concurrency: Optional[int],
                                 owner: str,
-                                job_id: Optional[str] = None) -> dict:
+                                job_id: Optional[str] = None,
+                                visible_chips=None) -> dict:
         import asyncio
         import inspect as _inspect
 
@@ -1124,6 +1367,7 @@ class ClusterRuntime:
 
         def init() -> Optional[bytes]:
             try:
+                self._apply_visible_chips(visible_chips)
                 self._ensure_job_env(job_id)
                 cls = self._fn.fetch(cls_key)
                 rargs, rkwargs = self._resolve_task_args(args)

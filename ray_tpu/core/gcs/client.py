@@ -106,13 +106,19 @@ class GcsClient:
                                    info=info)
 
     async def update_placement_group(self, pg_id: str,
-                                     updates: Dict[str, Any]) -> bool:
+                                     updates: Dict[str, Any],
+                                     expect_state: Optional[str] = None
+                                     ) -> bool:
         return await self.rpc.call("update_placement_group", pg_id=pg_id,
-                                   updates=updates)
+                                   updates=updates,
+                                   expect_state=expect_state)
 
     async def get_placement_group(self, pg_id: str
                                   ) -> Optional[Dict[str, Any]]:
         return await self.rpc.call("get_placement_group", pg_id=pg_id)
+
+    async def list_placement_groups(self) -> List[Dict[str, Any]]:
+        return await self.rpc.call("list_placement_groups")
 
     # -- misc -----------------------------------------------------------
     async def ping(self) -> str:

@@ -291,11 +291,17 @@ class GcsServer:
 
     async def handle_update_placement_group(
             self, conn: ServerConnection, *, pg_id: str,
-            updates: Dict[str, Any]) -> bool:
-        if pg_id not in self.placement_groups:
+            updates: Dict[str, Any],
+            expect_state: Optional[str] = None) -> bool:
+        """`expect_state` makes the update conditional (CAS): the async
+        owner-side scheduler must not resurrect a REMOVED group."""
+        info = self.placement_groups.get(pg_id)
+        if info is None:
             return False
-        self.placement_groups[pg_id].update(updates)
-        await self._publish(f"pg:{pg_id}", self.placement_groups[pg_id])
+        if expect_state is not None and info.get("state") != expect_state:
+            return False
+        info.update(updates)
+        await self._publish(f"pg:{pg_id}", info)
         return True
 
     async def handle_get_placement_group(

@@ -350,6 +350,41 @@ class LocalModeRuntime:
             return None
         return refs[0] if num_returns == 1 else refs
 
+    # -- placement groups (single node: reservation is a table entry) ----
+    def create_placement_group(self, bundles, strategy="PACK", name="",
+                               target_node_ids=None) -> str:
+        from ray_tpu.core.ids import PlacementGroupID
+        from ray_tpu.core.pg_scheduler import VALID_STRATEGIES
+
+        if strategy not in VALID_STRATEGIES:
+            raise ValueError(f"Invalid placement strategy {strategy!r}; "
+                             f"valid: {VALID_STRATEGIES}")
+        if not bundles or any(not b for b in bundles):
+            raise ValueError("placement group requires non-empty bundles")
+        pg_id = PlacementGroupID.of(self.job_id).hex()
+        if not hasattr(self, "_placement_groups"):
+            self._placement_groups = {}
+        self._placement_groups[pg_id] = {
+            "pg_id": pg_id, "bundles": [dict(b) for b in bundles],
+            "strategy": strategy, "name": name, "state": "CREATED",
+            "bundle_locations": [{"node_id": "local", "address": "local"}
+                                 for _ in bundles],
+        }
+        return pg_id
+
+    def placement_group_wait(self, pg_id, timeout=None) -> bool:
+        info = getattr(self, "_placement_groups", {}).get(pg_id)
+        return bool(info and info["state"] == "CREATED")
+
+    def remove_placement_group(self, pg_id) -> None:
+        info = getattr(self, "_placement_groups", {}).get(pg_id)
+        if info is not None:
+            info["state"] = "REMOVED"
+
+    def placement_group_table(self, pg_id=None):
+        table = getattr(self, "_placement_groups", {})
+        return table.get(pg_id) if pg_id is not None else dict(table)
+
     def cancel(self, ref: ObjectRef, force: bool = False,
                recursive: bool = True) -> None:
         task_id = ref.id().task_id()

@@ -36,6 +36,9 @@ class TaskOptions:
     retry_exceptions: Any = False
     runtime_env: Optional[dict] = None
     scheduling_strategy: Any = None
+    placement_group: Any = None  # PlacementGroup | pg_id hex | None
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
     enable_task_events: bool = True
     label_selector: Optional[dict] = None
     accelerator_type: Optional[str] = None
@@ -61,6 +64,9 @@ class ActorOptions:
     concurrency_groups: Optional[dict] = None
     runtime_env: Optional[dict] = None
     scheduling_strategy: Any = None
+    placement_group: Any = None  # PlacementGroup | pg_id hex | None
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
     enable_task_events: bool = True
     label_selector: Optional[dict] = None
     accelerator_type: Optional[str] = None
@@ -79,6 +85,10 @@ def _validate(updates: Dict[str, Any], *, for_actor: bool) -> None:
     if nr is not None and not (
             isinstance(nr, int) and nr >= 0) and nr not in ("streaming", "dynamic"):
         raise ValueError(f"num_returns must be int>=0 or 'streaming'/'dynamic', got {nr!r}")
+    # Explicitly unimplemented rather than silently ignored.
+    if updates.get("concurrency_groups"):
+        raise NotImplementedError(
+            "concurrency_groups are not supported yet; use max_concurrency")
 
 
 def task_options(updates: Dict[str, Any],

@@ -1,0 +1,113 @@
+"""Placement-group bundle→node selection policies.
+
+Reference equivalent: `src/ray/raylet/scheduling/policy/
+bundle_scheduling_policy.h` (+ `scorer.h`) — STRICT_PACK / PACK / SPREAD /
+STRICT_SPREAD over a cluster resource view. Runs owner-side here (the
+creating worker drives the 2PC), against the GCS node table; staleness is
+handled by the caller retrying on prepare failure.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+
+
+def _take(avail: Dict[str, float], demand: Dict[str, float]) -> None:
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def select_pg_nodes(bundles: List[Dict[str, float]],
+                    nodes: List[Dict[str, Any]], strategy: str,
+                    target_node_ids: Optional[List[str]] = None
+                    ) -> Optional[List[Dict[str, Any]]]:
+    """Pick one node per bundle, or None if infeasible against this view.
+
+    `target_node_ids` pins bundle i to the node with that id (used by the
+    TPU slice strategy: one bundle per host of one slice)."""
+    avail = {n["node_id"]: dict(n.get("resources_available", {}))
+             for n in nodes}
+    by_id = {n["node_id"]: n for n in nodes}
+
+    if target_node_ids is not None:
+        if len(target_node_ids) != len(bundles):
+            return None
+        out = []
+        for demand, nid in zip(bundles, target_node_ids):
+            if nid not in avail or not _fits(avail[nid], demand):
+                return None
+            _take(avail[nid], demand)
+            out.append(by_id[nid])
+        return out
+
+    # Most-available-first ordering (scorer.h tie-break: spread load).
+    def capacity(nid: str) -> float:
+        a = avail[nid]
+        return a.get("CPU", 0.0) + a.get("TPU", 0.0)
+
+    ordered = sorted(avail, key=capacity, reverse=True)
+
+    if strategy == "STRICT_PACK":
+        total: Dict[str, float] = {}
+        for b in bundles:
+            for k, v in b.items():
+                total[k] = total.get(k, 0.0) + v
+        for nid in ordered:
+            if _fits(avail[nid], total):
+                return [by_id[nid]] * len(bundles)
+        return None
+
+    if strategy == "STRICT_SPREAD":
+        out, used = [], set()
+        for demand in bundles:
+            nid = next((n for n in ordered
+                        if n not in used and _fits(avail[n], demand)), None)
+            if nid is None:
+                return None
+            used.add(nid)
+            _take(avail[nid], demand)
+            out.append(by_id[nid])
+        return out
+
+    if strategy == "PACK":
+        out: List[Dict[str, Any]] = []
+        used_order: List[str] = []
+        for demand in bundles:
+            # Prefer nodes already holding a bundle of this group.
+            nid = next((n for n in used_order if _fits(avail[n], demand)),
+                       None)
+            if nid is None:
+                nid = next((n for n in ordered if _fits(avail[n], demand)),
+                           None)
+            if nid is None:
+                return None
+            if nid not in used_order:
+                used_order.append(nid)
+            _take(avail[nid], demand)
+            out.append(by_id[nid])
+        return out
+
+    if strategy == "SPREAD":
+        out = []
+        last: Optional[str] = None
+        for demand in bundles:
+            # Best-effort spread: most-available feasible node that isn't
+            # the one we just used, falling back to any feasible node.
+            candidates = sorted((n for n in avail if _fits(avail[n], demand)),
+                                key=capacity, reverse=True)
+            if not candidates:
+                return None
+            nid = next((n for n in candidates if n != last), candidates[0])
+            last = nid
+            _take(avail[nid], demand)
+            out.append(by_id[nid])
+        return out
+
+    raise ValueError(f"unknown placement strategy {strategy!r}; "
+                     f"valid: {VALID_STRATEGIES}")

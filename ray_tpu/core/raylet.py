@@ -37,14 +37,37 @@ class _Worker:
         self.ready = asyncio.Event()
         self.actor_id: Optional[str] = None
         self.held: Dict[str, float] = {}  # resources held by active lease
+        self.bundle_key: Optional[str] = None  # PG bundle the lease drew from
+        self.chip_ids: List[int] = []  # TPU chips granted to this lease
+
+
+class _Bundle:
+    """One reserved placement-group bundle on this node (reference:
+    `src/ray/raylet/placement_group_resource_manager.h` — prepared bundles
+    hold node resources; commit makes them leasable; return releases)."""
+
+    def __init__(self, resources: Dict[str, float], chips: List[int]):
+        self.total = dict(resources)
+        self.available = dict(resources)
+        self.chips = list(chips)  # reserved, currently-unleased chip ids
+        self.committed = False
+        self.removed = False
+        self.prepared_at = time.monotonic()
+
+    def in_use(self) -> Dict[str, float]:
+        return {k: self.total[k] - self.available.get(k, 0.0)
+                for k in self.total
+                if self.total[k] - self.available.get(k, 0.0) > 1e-9}
 
 
 class _PendingLease:
     def __init__(self, demand: Dict[str, float], is_actor: bool,
-                 scheduling_key: str):
+                 scheduling_key: str,
+                 bundle_key: Optional[str] = None):
         self.demand = demand
         self.is_actor = is_actor
         self.scheduling_key = scheduling_key
+        self.bundle_key = bundle_key
         self.conn: Optional[ServerConnection] = None
         self.future: asyncio.Future = asyncio.get_event_loop().create_future()
 
@@ -69,6 +92,12 @@ class Raylet:
         self._workers: Dict[str, _Worker] = {}
         self._idle: List[_Worker] = []
         self._pending: List[_PendingLease] = []
+        # PG bundles reserved on this node, keyed "pg_id:bundle_index".
+        self._bundles: Dict[str, _Bundle] = {}
+        # Per-instance TPU chip ids (reference: resource_instance_set.h —
+        # fractional TPU demands don't get chip isolation).
+        self._chips_free: List[int] = list(
+            range(int(resources.get("TPU", 0))))
         self._next_lease = 0
         self._cluster_view: Dict[str, Dict[str, Any]] = {}
         self._raylet_clients: Dict[str, RpcClient] = {}
@@ -127,6 +156,7 @@ class Raylet:
                     n["node_id"]: n for n in await self._gcs.get_nodes()}
             except Exception:
                 logger.warning("heartbeat to GCS failed", exc_info=True)
+            self._reap_stale_prepares()
             await asyncio.sleep(period)
 
     def _on_node_update(self, data) -> None:
@@ -167,8 +197,7 @@ class Raylet:
             if worker in self._idle:
                 self._idle.remove(worker)
             if worker.held:
-                self._release(worker.held)
-                worker.held = {}
+                self._release_lease_resources(worker)
                 self._try_dispatch()
             if worker.actor_id:
                 try:
@@ -231,8 +260,27 @@ class Raylet:
     async def handle_request_worker_lease(
             self, conn: ServerConnection, *, resources: Dict[str, float],
             scheduling_key: str = "", is_actor: bool = False,
-            spillback_count: int = 0) -> Dict[str, Any]:
+            spillback_count: int = 0,
+            bundle: Optional[List[Any]] = None) -> Dict[str, Any]:
         demand = {k: float(v) for k, v in resources.items() if v}
+        if bundle is not None:
+            # Leases against a PG bundle are pinned to this node: no
+            # spillback, fail fast if the bundle is gone or can't fit.
+            key = f"{bundle[0]}:{bundle[1]}"
+            b = self._bundles.get(key)
+            if b is None or b.removed:
+                return {"error": "bundle_missing",
+                        "detail": f"bundle {key} not reserved on this node"}
+            if not self._fits(b.total, demand):
+                return {"error": "infeasible",
+                        "detail": f"demand {demand} exceeds bundle total "
+                                  f"{b.total}"}
+            pending = _PendingLease(demand, is_actor, scheduling_key,
+                                    bundle_key=key)
+            pending.conn = conn
+            self._pending.append(pending)
+            self._try_dispatch()
+            return await pending.future
         cfg = ray_config()
         local_fits = self._fits(self.resources_available, demand)
         # Hybrid policy (hybrid_scheduling_policy.h): pack locally while
@@ -261,12 +309,46 @@ class Raylet:
     def _feasible_locally(self, demand: Dict[str, float]) -> bool:
         return self._fits(self.resources_total, demand)
 
+    def _lease_source(self, pending: "_PendingLease"
+                      ) -> Optional[Dict[str, float]]:
+        """The resource pool this lease draws from: a PG bundle's reserved
+        resources, or the node's free pool. None = can't run now."""
+        if pending.bundle_key is not None:
+            b = self._bundles.get(pending.bundle_key)
+            if b is None or b.removed:
+                if not pending.future.done():
+                    pending.future.set_result({
+                        "error": "bundle_missing",
+                        "detail": f"bundle {pending.bundle_key} was removed"})
+                self._pending.remove(pending)
+                return None
+            return b.available if self._fits(b.available,
+                                             pending.demand) else None
+        return (self.resources_available
+                if self._fits(self.resources_available, pending.demand)
+                else None)
+
+    def _take_chips(self, pending: "_PendingLease") -> List[int]:
+        """Assign whole-chip TPU instance ids for the lease (reference:
+        tpu.py:214 TPU_VISIBLE_CHIPS isolation; fractional demand → none)."""
+        n = int(pending.demand.get("TPU", 0))
+        if n <= 0:
+            return []
+        if pending.bundle_key is not None:
+            b = self._bundles[pending.bundle_key]
+            pool = b.chips
+        else:
+            pool = self._chips_free
+        taken, pool[:] = pool[:n], pool[n:]
+        return taken
+
     def _try_dispatch(self) -> None:
         made_progress = True
         while made_progress and self._pending:
             made_progress = False
             for pending in list(self._pending):
-                if not self._fits(self.resources_available, pending.demand):
+                source = self._lease_source(pending)
+                if source is None:
                     continue
                 worker = self._get_idle_worker()
                 if worker is None:
@@ -281,12 +363,20 @@ class Raylet:
                         self._spawn_worker()
                     break
                 self._pending.remove(pending)
-                self._acquire(pending.demand)
+                chips = self._take_chips(pending)
+                if pending.bundle_key is not None:
+                    b = self._bundles[pending.bundle_key]
+                    for k, v in pending.demand.items():
+                        b.available[k] = b.available.get(k, 0.0) - v
+                else:
+                    self._acquire(pending.demand)
                 self._next_lease += 1
                 lease_id = f"{self.node_id[:8]}-{self._next_lease}"
                 worker.state = "actor" if pending.is_actor else "leased"
                 worker.lease_id = lease_id
                 worker.held = dict(pending.demand)
+                worker.bundle_key = pending.bundle_key
+                worker.chip_ids = chips
                 if not pending.future.done():
                     pending.future.set_result({
                         "granted": {
@@ -295,6 +385,8 @@ class Raylet:
                             "lease_id": lease_id,
                             "node_id": self.node_id,
                             "resources": pending.demand,
+                            "bundle": pending.bundle_key,
+                            "chip_ids": chips,
                         }})
                 made_progress = True
 
@@ -311,19 +403,52 @@ class Raylet:
         alive = sum(1 for w in self._workers.values() if w.state != "dead")
         return alive < limit
 
+    def _release_lease_resources(self, worker: _Worker) -> None:
+        """Return a lease's resources + chips to where they came from: the
+        PG bundle if it's still live, else the node pool (a removed bundle's
+        in-use share flows back to the pool as its leases end)."""
+        b = (self._bundles.get(worker.bundle_key)
+             if worker.bundle_key else None)
+        if b is not None and not b.removed:
+            for k, v in worker.held.items():
+                b.available[k] = min(b.available.get(k, 0.0) + v,
+                                     b.total.get(k, v))
+            b.chips.extend(worker.chip_ids)
+        else:
+            self._release(worker.held)
+            self._chips_free.extend(worker.chip_ids)
+            if b is not None:
+                # Removed bundle draining: shrink its in-use record and
+                # drop the entry once the last lease ends.
+                for k, v in worker.held.items():
+                    b.total[k] = b.total.get(k, 0.0) - v
+                    if b.total[k] <= 1e-9:
+                        del b.total[k]
+                if not b.total:
+                    self._bundles.pop(worker.bundle_key, None)
+        worker.held = {}
+        worker.chip_ids = []
+        worker.bundle_key = None
+
     async def handle_return_worker(self, conn: ServerConnection, *,
                                    lease_id: str, worker_id: str,
                                    resources: Optional[Dict[str, float]]
                                    = None, dead: bool = False) -> bool:
         worker = self._workers.get(worker_id)
         if worker is not None and worker.lease_id == lease_id:
+            # A worker that held TPU chips cannot be reused: libtpu pins
+            # chip visibility at first jax init, so a recycled process
+            # would silently compute on its OLD chips while the raylet
+            # leases them to someone else. Retire it instead.
+            had_chips = bool(worker.chip_ids)
             # The raylet's own bookkeeping is authoritative for what this
             # lease holds — not the client's view.
-            self._release(worker.held)
-            worker.held = {}
+            self._release_lease_resources(worker)
             worker.lease_id = None
-            if dead or worker.proc.poll() is not None:
+            if dead or had_chips or worker.proc.poll() is not None:
                 worker.state = "dead"
+                if worker.proc.poll() is None:
+                    worker.proc.terminate()
             else:
                 worker.state = "idle"
                 worker.actor_id = None
@@ -341,13 +466,83 @@ class Raylet:
         if worker is not None:
             worker.actor_id = actor_id
             if release:
-                self._release(release)
+                b = (self._bundles.get(worker.bundle_key)
+                     if worker.bundle_key else None)
+                if b is not None and not b.removed:
+                    for k, v in release.items():
+                        b.available[k] = min(b.available.get(k, 0.0) + v,
+                                             b.total.get(k, v))
+                else:
+                    self._release(release)
                 for k, v in release.items():
                     worker.held[k] = worker.held.get(k, 0.0) - v
                     if worker.held[k] <= 1e-9:
                         del worker.held[k]
                 self._try_dispatch()
         return True
+
+    # ------------------------------------------------------------------
+    # placement-group bundles: 2PC reserve/commit/return (reference:
+    # node_manager.cc:1821 HandlePrepareBundleResources, :1837
+    # HandleCommitBundleResources + placement_group_resource_manager.h)
+    # ------------------------------------------------------------------
+    async def handle_prepare_bundle(self, conn: ServerConnection, *,
+                                    pg_id: str, bundle_index: int,
+                                    resources: Dict[str, float]
+                                    ) -> Dict[str, Any]:
+        key = f"{pg_id}:{bundle_index}"
+        if key in self._bundles and not self._bundles[key].removed:
+            return {"ok": True}  # idempotent re-prepare
+        demand = {k: float(v) for k, v in resources.items() if v}
+        if not self._fits(self.resources_available, demand):
+            return {"ok": False,
+                    "reason": f"insufficient resources for bundle {key}: "
+                              f"need {demand}, have "
+                              f"{self.resources_available}"}
+        self._acquire(demand)
+        n_chips = int(demand.get("TPU", 0))
+        chips, self._chips_free[:] = (self._chips_free[:n_chips],
+                                      self._chips_free[n_chips:])
+        self._bundles[key] = _Bundle(demand, chips)
+        return {"ok": True}
+
+    async def handle_commit_bundle(self, conn: ServerConnection, *,
+                                   pg_id: str, bundle_index: int) -> bool:
+        b = self._bundles.get(f"{pg_id}:{bundle_index}")
+        if b is None or b.removed:
+            return False
+        b.committed = True
+        return True
+
+    async def handle_return_bundle(self, conn: ServerConnection, *,
+                                   pg_id: str, bundle_index: int) -> bool:
+        return self._return_bundle(f"{pg_id}:{bundle_index}")
+
+    def _return_bundle(self, key: str) -> bool:
+        b = self._bundles.get(key)
+        if b is None or b.removed:
+            return False
+        # Unused share back to the pool now; b.total shrinks to the in-use
+        # share, which drains back as each outstanding lease ends
+        # (_release_lease_resources) — empty total deletes the entry.
+        b.removed = True
+        self._release(b.available)
+        self._chips_free.extend(b.chips)
+        b.total = b.in_use()
+        b.available = {}
+        b.chips = []
+        if not b.total:
+            del self._bundles[key]
+        return True
+
+    def _reap_stale_prepares(self) -> None:
+        """Drop prepared-but-never-committed bundles (owner died between
+        the 2PC phases) so their reservations don't leak."""
+        cutoff = time.monotonic() - 30.0
+        for key, b in list(self._bundles.items()):
+            if not b.committed and not b.removed and b.prepared_at < cutoff:
+                logger.warning("returning stale uncommitted bundle %s", key)
+                self._return_bundle(key)
 
     # ------------------------------------------------------------------
     # object store RPCs (reference: plasma protocol + object_manager)
@@ -475,6 +670,9 @@ class Raylet:
             "num_workers": len([w for w in self._workers.values()
                                 if w.state != "dead"]),
             "pending_leases": len(self._pending),
+            "bundles": {k: {"total": b.total, "available": b.available,
+                            "committed": b.committed}
+                        for k, b in self._bundles.items() if not b.removed},
             "store": self.store.stats(),
         }
 
@@ -500,9 +698,12 @@ def main() -> None:
     async def run():
         import signal
 
+        from ray_tpu.parallel.tpu import slice_info
+
         raylet = Raylet(
             node_id=args.node_id, gcs_address=args.gcs,
             resources=json.loads(args.resources),
+            labels=slice_info() or {},
             object_store_memory=args.object_store_memory or None,
             is_head=args.head, port=args.port)
         await raylet.start()

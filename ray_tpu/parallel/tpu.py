@@ -78,10 +78,20 @@ def local_tpu_resources() -> Dict[str, float]:
     return out
 
 
+# Topology bounds for a process owning a subset of a host's chips
+# (reference: tpu.py:39-44 chips-per-host bounds for 1/2/4-chip slices).
+_CHIP_BOUNDS = {1: "1,1,1", 2: "1,2,1", 4: "2,2,1"}
+
+
 def visible_chip_env(chip_ids) -> Dict[str, str]:
     """Env vars isolating a worker to the given chips (reference:
-    tpu.py:214 set_current_process_visible_accelerator_ids)."""
+    tpu.py:214 set_current_process_visible_accelerator_ids). Bounds are
+    only pinned for chip counts with a known sub-host topology; other
+    counts get visibility masking alone."""
     ids = ",".join(str(c) for c in chip_ids)
-    return {"TPU_VISIBLE_CHIPS": ids,
-            "TPU_PROCESS_BOUNDS": "1,1,1",
-            "TPU_CHIPS_PER_PROCESS_BOUNDS": "1,1,1"}
+    out = {"TPU_VISIBLE_CHIPS": ids}
+    bounds = _CHIP_BOUNDS.get(len(list(chip_ids)))
+    if bounds is not None:
+        out["TPU_PROCESS_BOUNDS"] = "1,1,1"
+        out["TPU_CHIPS_PER_PROCESS_BOUNDS"] = bounds
+    return out

@@ -32,13 +32,43 @@ class BackendExecutor:
         self._backend = backend_config.backend_cls()
         self._scaling = scaling_config or ScalingConfig()
         self.worker_group: Optional[WorkerGroup] = None
+        self._owned_pg = None  # PG we created (removed on shutdown)
 
     def start(self, placement_group=None) -> None:
+        if placement_group is None:
+            # Gang-reserve the whole worker group up front (reference:
+            # trainers always run under a PG — tune/execution/
+            # placement_groups.py); partial gangs deadlock SPMD training.
+            from ray_tpu.util import placement_group as pg_factory
+
+            placement_group = pg_factory(
+                self._scaling.as_placement_group_factory(),
+                strategy=self._scaling.placement_strategy,
+                name="train-worker-group")
+            self._owned_pg = placement_group
+            if not placement_group.wait(timeout_seconds=60.0):
+                state = _pg_state(placement_group)
+                self._remove_owned_pg()
+                raise RuntimeError(
+                    f"could not gang-reserve {self._scaling.num_workers} "
+                    f"training worker(s) "
+                    f"({self._scaling.worker_resources()} each, "
+                    f"{self._scaling.placement_strategy}): {state}")
         self.worker_group = WorkerGroup(
             self._scaling.num_workers,
             self._scaling.worker_resources(),
             placement_group=placement_group)
         self._backend.on_start(self.worker_group, self._backend_config)
+
+    def _remove_owned_pg(self) -> None:
+        if self._owned_pg is not None:
+            try:
+                from ray_tpu.util import remove_placement_group
+
+                remove_placement_group(self._owned_pg)
+            except Exception:
+                pass
+            self._owned_pg = None
 
     def start_training(self, train_fn: Callable, config: Optional[dict],
                        *, trial_name: str = "", checkpoint=None,
@@ -141,3 +171,14 @@ class BackendExecutor:
                 pass
             self.worker_group.shutdown()
             self.worker_group = None
+        self._remove_owned_pg()
+
+
+def _pg_state(pg) -> str:
+    try:
+        from ray_tpu.util import placement_group_table
+
+        info = placement_group_table(pg) or {}
+        return f"state={info.get('state')} {info.get('detail', '')}".strip()
+    except Exception:
+        return "state unavailable"

@@ -135,10 +135,21 @@ class WorkerGroup:
                                 "max_restarts": 0}
         if resources:
             opts["resources"] = resources
-        if placement_group is not None:
-            opts["placement_group"] = placement_group
         cls = ray_tpu.remote(**opts)(TrainWorker)
-        self.workers = [cls.remote() for _ in range(num_workers)]
+        if placement_group is not None:
+            # Worker i lives in bundle i when the group has one bundle per
+            # worker (ScalingConfig.as_placement_group_factory); otherwise
+            # let the group round-robin (-1 = any bundle).
+            n_bundles = getattr(placement_group, "bundle_count", 0)
+            self.workers = [
+                cls.options(
+                    placement_group=placement_group,
+                    placement_group_bundle_index=(
+                        i if n_bundles == num_workers else -1),
+                ).remote()
+                for i in range(num_workers)]
+        else:
+            self.workers = [cls.remote() for _ in range(num_workers)]
         self.metadata: List[Dict[str, Any]] = ray_tpu.get(
             [w.metadata.remote() for w in self.workers], timeout=120)
         # Deterministic rank order: group by node, stable by pid

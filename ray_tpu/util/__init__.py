@@ -1,0 +1,19 @@
+"""ray_tpu.util — utility APIs layered on the core runtime.
+
+Reference equivalent: `python/ray/util/` (placement groups, collective,
+actor pools, state API).
+"""
+
+from ray_tpu.util.placement_group import (  # noqa: F401
+    PlacementGroup, get_current_placement_group, placement_group,
+    placement_group_table, remove_placement_group,
+    tpu_slice_placement_group)
+
+__all__ = [
+    "PlacementGroup",
+    "placement_group",
+    "remove_placement_group",
+    "placement_group_table",
+    "get_current_placement_group",
+    "tpu_slice_placement_group",
+]

@@ -1,0 +1,147 @@
+"""Placement groups: atomic gang reservation of resources across nodes.
+
+Reference equivalent: `python/ray/util/placement_group.py:41,146`
+(PlacementGroup handle + factory) over the GCS/raylet 2PC
+(`gcs_placement_group_scheduler.h`). TPU-first addition:
+`tpu_slice_placement_group` gang-reserves one bundle per host of a single
+TPU slice using the `ray_tpu.slice` node labels reported by each raylet
+(`ray_tpu/parallel/tpu.py slice_info`), so an SPMD job's workers always
+land on one ICI domain — a cross-slice gang is refused, not scattered.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu.core import worker as _worker
+
+
+class PlacementGroup:
+    """Handle to a placement group (reference: placement_group.py:41)."""
+
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]],
+                 strategy: str = "PACK"):
+        self.id = pg_id
+        self._bundles = [dict(b) for b in bundles]
+        self.strategy = strategy
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return [dict(b) for b in self._bundles]
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self._bundles)
+
+    def ready(self):
+        """ObjectRef that resolves when the group is placed — `get(pg.
+        ready())` mirrors the reference's await-style readiness check."""
+        import ray_tpu
+
+        pg_id = self.id
+
+        @ray_tpu.remote(num_cpus=0)
+        def _pg_ready() -> bool:
+            return True
+
+        # Scheduling the probe task inside bundle 0 proves the reservation
+        # is live end-to-end (lease from the bundle, not just table state).
+        self.wait(timeout_seconds=None)
+        return _pg_ready.options(
+            placement_group=pg_id,
+            placement_group_bundle_index=0).remote()
+
+    def wait(self, timeout_seconds: Optional[float] = 30.0) -> bool:
+        return _worker.current_runtime().placement_group_wait(
+            self.id, timeout=timeout_seconds)
+
+    def __repr__(self) -> str:
+        return (f"PlacementGroup(id={self.id[:12]}..., "
+                f"strategy={self.strategy}, bundles={self._bundles})")
+
+
+def placement_group(bundles: List[Dict[str, float]],
+                    strategy: str = "PACK", name: str = "",
+                    lifetime: Optional[str] = None,
+                    _target_node_ids: Optional[List[str]] = None
+                    ) -> PlacementGroup:
+    """Reserve `bundles` across the cluster (reference:
+    placement_group.py:146). Returns immediately; scheduling is async —
+    use `pg.wait()` / `get(pg.ready())` before relying on it."""
+    rt = _worker.current_runtime()
+    pg_id = rt.create_placement_group(bundles, strategy=strategy, name=name,
+                                      target_node_ids=_target_node_ids)
+    return PlacementGroup(pg_id, bundles, strategy)
+
+
+def remove_placement_group(pg: "PlacementGroup | str") -> None:
+    pg_id = pg.id if isinstance(pg, PlacementGroup) else pg
+    _worker.current_runtime().remove_placement_group(pg_id)
+
+
+def placement_group_table(pg: "PlacementGroup | str | None" = None):
+    pg_id = pg.id if isinstance(pg, PlacementGroup) else pg
+    return _worker.current_runtime().placement_group_table(pg_id)
+
+
+def get_current_placement_group() -> Optional[PlacementGroup]:
+    """The PG capturing the current task/actor, if any (reference:
+    placement_group.py get_current_placement_group). Capture of child
+    tasks is not propagated yet, so this is None outside explicit use."""
+    return None
+
+
+# ---------------------------------------------------------------------
+# TPU slice gang scheduling (TPU-native; no reference counterpart —
+# generalizes accelerators/tpu.py single-host awareness to pod slices)
+# ---------------------------------------------------------------------
+def tpu_slice_placement_group(
+        num_hosts: int, chips_per_host: int = 4,
+        cpus_per_host: float = 1.0,
+        accelerator_type: Optional[str] = None) -> PlacementGroup:
+    """Gang-reserve one bundle per host of a SINGLE TPU slice.
+
+    Scans node labels for a slice (`ray_tpu.slice`) with at least
+    `num_hosts` live hosts that can each hold `chips_per_host` chips, and
+    pins bundle i to host i of that slice (STRICT_SPREAD across the slice's
+    hosts). Raises ValueError immediately — fail fast — when no single
+    slice can hold the gang; it never scatters a gang across slices, since
+    ICI collectives cannot span slice boundaries."""
+    import ray_tpu
+
+    slices: Dict[str, List[dict]] = {}
+    for node in ray_tpu.nodes():
+        if not node.get("Alive"):
+            continue
+        labels = node.get("Labels", {})
+        name = labels.get("ray_tpu.slice")
+        if not name:
+            continue
+        if (accelerator_type and
+                labels.get("ray_tpu.accelerator_type") != accelerator_type):
+            continue
+        if node.get("Resources", {}).get("TPU", 0) < chips_per_host:
+            continue
+        slices.setdefault(name, []).append(node)
+
+    for name, hosts in sorted(slices.items()):
+        if len(hosts) < num_hosts:
+            continue
+        hosts = sorted(
+            hosts,
+            key=lambda n: int(n["Labels"].get("ray_tpu.worker_id", 0)))
+        chosen = hosts[:num_hosts]
+        bundle = {"CPU": cpus_per_host, "TPU": float(chips_per_host)}
+        return placement_group(
+            [dict(bundle) for _ in range(num_hosts)],
+            strategy="STRICT_SPREAD",
+            name=f"tpu-slice-{name}",
+            _target_node_ids=[n["NodeID"] for n in chosen])
+
+    available = {name: len(hosts) for name, hosts in slices.items()}
+    raise ValueError(
+        f"No single TPU slice with {num_hosts} host(s) x "
+        f"{chips_per_host} chip(s)"
+        + (f" of type {accelerator_type}" if accelerator_type else "")
+        + f" is available (slices seen: {available or 'none'}); "
+          "a gang cannot span slices.")

@@ -1,0 +1,203 @@
+"""Placement groups: 2PC bundle reservation, strategies, TPU slice gangs.
+
+Reference coverage class: python/ray/tests/test_placement_group*.py (5
+files) on the ray_start_cluster fixture, plus the TPU-native slice-gang
+behavior (no reference counterpart; generalizes accelerators/tpu.py).
+"""
+
+import pytest
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def pg_cluster():
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    # Two TPU hosts of slice "sliceA" (4 chips each) + one plain CPU node.
+    tpu_nodes = [
+        cluster.add_node(
+            num_cpus=4, resources={"TPU": 4.0},
+            env={"RAY_TPU_FAKE_SLICE": "v5e-8:2",
+                 "TPU_NAME": "sliceA",
+                 "TPU_WORKER_ID": str(i)})
+        for i in range(2)
+    ]
+    cpu_node = cluster.add_node(num_cpus=4)
+    ray_tpu.init(address=cluster.address, ignore_reinit_error=True)
+    cluster.wait_for_nodes(4)
+    yield ray_tpu, cluster, tpu_nodes, cpu_node
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def _bundle_nodes(ray, pg):
+    info = ray.util.placement_group_table(pg)
+    return [loc["node_id"] for loc in info["bundle_locations"]]
+
+
+def test_strict_pack_lands_on_one_node(pg_cluster):
+    ray, *_ = pg_cluster
+    pg = ray.util.placement_group([{"CPU": 2}, {"CPU": 2}],
+                                  strategy="STRICT_PACK")
+    assert pg.wait(timeout_seconds=30)
+    nodes = _bundle_nodes(ray, pg)
+    assert len(set(nodes)) == 1
+    ray.util.remove_placement_group(pg)
+
+
+def test_strict_spread_lands_on_distinct_nodes(pg_cluster):
+    ray, *_ = pg_cluster
+    pg = ray.util.placement_group([{"CPU": 1}, {"CPU": 1}, {"CPU": 1}],
+                                  strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=30)
+    nodes = _bundle_nodes(ray, pg)
+    assert len(set(nodes)) == 3
+    ray.util.remove_placement_group(pg)
+
+
+def test_infeasible_pg_fails_not_hangs(pg_cluster):
+    ray, *_ = pg_cluster
+    pg = ray.util.placement_group([{"CPU": 64}], strategy="STRICT_PACK")
+    assert not pg.wait(timeout_seconds=15)
+    info = ray.util.placement_group_table(pg)
+    assert info["state"] in ("PENDING", "INFEASIBLE")
+    ray.util.remove_placement_group(pg)
+
+
+def test_tasks_and_actors_run_in_bundles(pg_cluster):
+    """Leases against bundles land on the reserved node and release back
+    into the bundle, and bundle capacity is enforced."""
+    ray, *_ = pg_cluster
+    pg = ray.util.placement_group([{"CPU": 2}, {"CPU": 2}],
+                                  strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=30)
+    expected = _bundle_nodes(ray, pg)
+
+    @ray.remote(num_cpus=1)
+    def where():
+        from ray_tpu import get_runtime_context
+        return get_runtime_context().get_node_id()
+
+    n0 = ray.get(where.options(placement_group=pg,
+                               placement_group_bundle_index=0).remote(),
+                 timeout=60)
+    n1 = ray.get(where.options(placement_group=pg,
+                               placement_group_bundle_index=1).remote(),
+                 timeout=60)
+    assert [n0, n1] == expected
+
+    @ray.remote(num_cpus=2)
+    class Holder:
+        def node(self):
+            from ray_tpu import get_runtime_context
+            return get_runtime_context().get_node_id()
+
+    a = Holder.options(placement_group=pg,
+                       placement_group_bundle_index=0).remote()
+    assert ray.get(a.node.remote(), timeout=60) == expected[0]
+    ray.kill(a)
+    ray.util.remove_placement_group(pg)
+
+
+def test_removed_pg_fails_fast(pg_cluster):
+    ray, *_ = pg_cluster
+    pg = ray.util.placement_group([{"CPU": 1}])
+    assert pg.wait(timeout_seconds=30)
+    ray.util.remove_placement_group(pg)
+
+    @ray.remote(num_cpus=1)
+    def f():
+        return 1
+
+    ref = f.options(placement_group=pg).remote()
+    with pytest.raises(Exception):
+        ray.get(ref, timeout=30)
+
+
+def test_tpu_slice_gang_strict_on_one_slice(pg_cluster):
+    """A 2-host TPU gang lands on sliceA's two hosts, one bundle each."""
+    ray, cluster, tpu_nodes, _ = pg_cluster
+    pg = ray.util.tpu_slice_placement_group(num_hosts=2, chips_per_host=4)
+    assert pg.wait(timeout_seconds=30)
+    nodes = _bundle_nodes(ray, pg)
+    assert sorted(nodes) == sorted(n["node_id"] for n in tpu_nodes)
+    ray.util.remove_placement_group(pg)
+
+
+def test_cross_slice_gang_fails_fast(pg_cluster):
+    """Asking for more hosts than any one slice has raises immediately."""
+    ray, *_ = pg_cluster
+    with pytest.raises(ValueError, match="cannot span slices"):
+        ray.util.tpu_slice_placement_group(num_hosts=3, chips_per_host=4)
+
+
+def test_train_gang_strict_pack_on_slice_host(pg_cluster):
+    """A 4-worker JaxTrainer gang (1 chip each, STRICT_PACK) lands whole
+    on one slice host with disjoint chip assignments — the TPU gang
+    scheduling the WorkerGroup previously only pretended to do."""
+    ray, *_ = pg_cluster
+    from ray_tpu.air.config import RunConfig, ScalingConfig
+    from ray_tpu.train import JaxTrainer
+    from ray_tpu.train.backend import JaxConfig
+
+    def loop(config):
+        import os
+
+        from ray_tpu import train
+        train.report({
+            "rank": train.get_world_rank(),
+            "node": __import__("ray_tpu").get_runtime_context()
+            .get_node_id(),
+            "chips": os.environ.get("TPU_VISIBLE_CHIPS", ""),
+        })
+
+    import cloudpickle
+
+    from ray_tpu.train._internal.backend_executor import BackendExecutor
+
+    executor = BackendExecutor(
+        JaxConfig(platform="cpu"),
+        ScalingConfig(num_workers=4, use_tpu=True, chips_per_worker=1,
+                      placement_strategy="STRICT_PACK"))
+    try:
+        executor.start()
+        executor.start_training(cloudpickle.dumps(loop), {})
+        results = executor.get_next_results()
+        assert results is not None and len(results) == 4
+        nodes = {r["metrics"]["node"] for r in results}
+        assert len(nodes) == 1, f"gang scattered across {nodes}"
+        chips = [r["metrics"]["chips"] for r in results]
+        assert all(chips), chips
+        assert len(set(chips)) == 4, f"chips not disjoint: {chips}"
+        assert executor.get_next_results() is None
+    finally:
+        executor.shutdown()
+    _ = (JaxTrainer, RunConfig)  # gang path above is what trainers use
+
+
+def test_colocated_tpu_actors_see_disjoint_chips(pg_cluster):
+    """Two TPU actors on one host get disjoint TPU_VISIBLE_CHIPS."""
+    ray, *_ = pg_cluster
+
+    @ray.remote(num_cpus=1, resources={"TPU": 2.0})
+    class TpuActor:
+        def visible(self):
+            import os
+            return (os.environ.get("TPU_VISIBLE_CHIPS"),
+                    __import__("ray_tpu").get_runtime_context()
+                    .get_node_id())
+
+    a, b = TpuActor.remote(), TpuActor.remote()
+    (chips_a, node_a), (chips_b, node_b) = ray.get(
+        [a.visible.remote(), b.visible.remote()], timeout=60)
+    assert chips_a and chips_b
+    set_a = set(chips_a.split(","))
+    set_b = set(chips_b.split(","))
+    assert len(set_a) == 2 and len(set_b) == 2
+    if node_a == node_b:
+        assert not (set_a & set_b), (chips_a, chips_b)
+    for h in (a, b):
+        ray.kill(h)
