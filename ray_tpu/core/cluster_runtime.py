@@ -971,13 +971,9 @@ class ClusterRuntime:
                                target_node_ids: Optional[List[str]] = None
                                ) -> str:
         from ray_tpu.core.ids import PlacementGroupID
-        from ray_tpu.core.pg_scheduler import VALID_STRATEGIES
+        from ray_tpu.core.pg_scheduler import validate_pg_args
 
-        if strategy not in VALID_STRATEGIES:
-            raise ValueError(f"Invalid placement strategy {strategy!r}; "
-                             f"valid: {VALID_STRATEGIES}")
-        if not bundles or any(not b for b in bundles):
-            raise ValueError("placement group requires non-empty bundles")
+        validate_pg_args(bundles, strategy)
         pg_id = PlacementGroupID.of(self.job_id).hex()
         info = {
             "bundles": [dict(b) for b in bundles],
@@ -1015,41 +1011,51 @@ class ClusterRuntime:
                     continue
                 prepared: List[Tuple[int, dict]] = []
                 failure = None
-                for idx, node in enumerate(placement):
-                    client = await self._raylet_client(node["address"])
-                    r = await client.call(
-                        "prepare_bundle", pg_id=pg_id, bundle_index=idx,
-                        resources=bundles[idx], timeout=10.0)
-                    if not r.get("ok"):
-                        failure = r.get("reason", "prepare rejected")
-                        break
-                    prepared.append((idx, node))
-                if failure is not None:
-                    detail = failure
-                    for idx, node in prepared:
+                try:
+                    for idx, node in enumerate(placement):
                         client = await self._raylet_client(node["address"])
-                        await client.call("return_bundle", pg_id=pg_id,
-                                          bundle_index=idx, timeout=10.0)
-                    await asyncio.sleep(0.25 * (attempt + 1))
-                    continue
+                        r = await client.call(
+                            "prepare_bundle", pg_id=pg_id, bundle_index=idx,
+                            resources=bundles[idx], timeout=10.0)
+                        if not r.get("ok"):
+                            failure = r.get("reason", "prepare rejected")
+                            break
+                        prepared.append((idx, node))
+                    committed_all = False
+                    if failure is None:
+                        for idx, node in prepared:
+                            client = await self._raylet_client(
+                                node["address"])
+                            await client.call("commit_bundle", pg_id=pg_id,
+                                              bundle_index=idx,
+                                              timeout=10.0)
+                        committed_all = True
+                except Exception as e:  # noqa: BLE001
+                    failure = str(e)
+                    committed_all = False
+                if failure is None and committed_all:
+                    # CAS on PENDING: if a concurrent remove won, roll the
+                    # committed bundles back, don't resurrect the PG.
+                    ok = await self._gcs.update_placement_group(pg_id, {
+                        "state": "CREATED",
+                        "bundle_locations": [
+                            {"node_id": n["node_id"],
+                             "address": n["address"]} for n in placement],
+                    }, expect_state="PENDING")
+                    if ok:
+                        return
+                # Roll back EVERYTHING reserved this attempt — including
+                # already-committed bundles — or the reservation leaks
+                # (neither the reaper nor remove would ever see it).
+                detail = failure or "removed concurrently"
                 for idx, node in prepared:
-                    client = await self._raylet_client(node["address"])
-                    await client.call("commit_bundle", pg_id=pg_id,
-                                      bundle_index=idx, timeout=10.0)
-                # CAS on PENDING: if a concurrent remove won, roll the
-                # committed bundles back instead of resurrecting the PG.
-                ok = await self._gcs.update_placement_group(pg_id, {
-                    "state": "CREATED",
-                    "bundle_locations": [
-                        {"node_id": n["node_id"], "address": n["address"]}
-                        for n in placement],
-                }, expect_state="PENDING")
-                if not ok:
-                    for idx, node in prepared:
+                    try:
                         client = await self._raylet_client(node["address"])
                         await client.call("return_bundle", pg_id=pg_id,
                                           bundle_index=idx, timeout=10.0)
-                return
+                    except Exception:
+                        pass
+                await asyncio.sleep(0.25 * (attempt + 1))
             except Exception as e:  # noqa: BLE001
                 detail = str(e)
                 await asyncio.sleep(0.25 * (attempt + 1))
@@ -1196,7 +1202,17 @@ class ClusterRuntime:
     def _ensure_job_env(self, job_id: Optional[str]) -> None:
         """Extend sys.path with the driver's entries so driver-local modules
         (test files, scripts) resolve when unpickling by reference."""
-        if not job_id or job_id in self._job_envs_applied:
+        if not job_id:
+            return
+        if self.mode == "worker" and len(job_id) == len(self.job_id.hex()):
+            # Adopt the job we execute for — on EVERY push, since a reused
+            # worker can serve different jobs across leases: tasks/actors
+            # submitted FROM this worker (e.g. a Tune trial spawning its
+            # training gang) must carry the original driver's job so their
+            # workers resolve driver-local modules too (reference: job_id
+            # rides the TaskSpec end-to-end).
+            self.job_id = JobID(bytes.fromhex(job_id))
+        if job_id in self._job_envs_applied:
             return
         try:
             info = self._loop.run(self._gcs.get_job(job_id), timeout=10)
@@ -1456,6 +1472,7 @@ class ClusterRuntime:
             "NodeID": n["node_id"],
             "Alive": n["alive"],
             "Resources": n.get("resources_total", {}),
+            "Available": n.get("resources_available", {}),
             "NodeManagerAddress": n.get("address"),
             "IsHeadNode": n.get("is_head", False),
             "Labels": n.get("labels", {}),

@@ -354,13 +354,9 @@ class LocalModeRuntime:
     def create_placement_group(self, bundles, strategy="PACK", name="",
                                target_node_ids=None) -> str:
         from ray_tpu.core.ids import PlacementGroupID
-        from ray_tpu.core.pg_scheduler import VALID_STRATEGIES
+        from ray_tpu.core.pg_scheduler import validate_pg_args
 
-        if strategy not in VALID_STRATEGIES:
-            raise ValueError(f"Invalid placement strategy {strategy!r}; "
-                             f"valid: {VALID_STRATEGIES}")
-        if not bundles or any(not b for b in bundles):
-            raise ValueError("placement group requires non-empty bundles")
+        validate_pg_args(bundles, strategy)
         pg_id = PlacementGroupID.of(self.job_id).hex()
         if not hasattr(self, "_placement_groups"):
             self._placement_groups = {}

@@ -14,6 +14,15 @@ from typing import Any, Dict, List, Optional
 VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
 
 
+def validate_pg_args(bundles, strategy: str) -> None:
+    """Shared by every runtime that creates placement groups."""
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"Invalid placement strategy {strategy!r}; "
+                         f"valid: {VALID_STRATEGIES}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("placement group requires non-empty bundles")
+
+
 def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
     return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
 

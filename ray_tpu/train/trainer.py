@@ -52,6 +52,39 @@ class JaxTrainer:
 
     # ------------------------------------------------------------------
     def fit(self) -> Result:
+        """Run as a single-trial Tune job (reference: base_trainer.py:579 —
+        `fit` wraps the trainer `as_trainable` and drives it through the
+        Tune controller). Raises TrainingFailedError after exhausting
+        FailureConfig.max_failures, like the reference."""
+        from ray_tpu.tune.trial import ERROR
+        from ray_tpu.tune.tuner import TuneConfig, Tuner
+
+        run_name = self._run_config.name or f"JaxTrainer_{int(time.time())}"
+        self._run_config.name = run_name
+        grid = Tuner(self, run_config=self._run_config,
+                     tune_config=TuneConfig(num_samples=1)).fit()
+        trial = grid[0]
+        if trial.status == ERROR:
+            raise TrainingFailedError(trial.error or "training failed")
+        result = trial.final
+        if not isinstance(result, Result):
+            raise TrainingFailedError(
+                f"trainable returned no Result (got {type(result)})")
+        if result.error is not None:
+            raise result.error
+        return result
+
+    def _run(self, config: Optional[dict] = None) -> Result:
+        """The gang-training loop body (runs inside the Tune trial actor,
+        or directly on the driver via `as_trainable()()`)."""
+        from ray_tpu.air.session import _get_session
+
+        if config:
+            merged = dict(self._train_config or {})
+            merged.update(config)
+            self._train_config = merged
+        tune_session = _get_session(required=False)
+
         run_name = self._run_config.name or f"JaxTrainer_{int(time.time())}"
         exp_dir = os.path.join(self._run_config.resolved_storage_path(),
                                run_name)
@@ -60,6 +93,10 @@ class JaxTrainer:
         max_failures = self._run_config.failure_config.max_failures
         failures = 0
         checkpoint = self._resume_checkpoint
+        if checkpoint is None and tune_session is not None:
+            # Experiment resume: the controller re-seeds an interrupted
+            # trial with its latest persisted checkpoint.
+            checkpoint = tune_session.loaded_checkpoint
         latest_ckpt: Optional[Checkpoint] = checkpoint
         history: List[Dict[str, Any]] = []
         ckpt_index = 0
@@ -90,6 +127,11 @@ class JaxTrainer:
                             exp_dir, ckpt_index, ckpt)
                         ckpt_index += 1
                         self._prune_checkpoints(exp_dir)
+                    if tune_session is not None:
+                        # Forward the round to the Tune controller: it
+                        # records progress, persists the trial checkpoint,
+                        # and may raise _StopTraining (scheduler stop).
+                        tune_session.report(metrics, checkpoint=ckpt)
                 last = history[-1] if history else {}
                 return Result(metrics=last, checkpoint=latest_ckpt,
                               path=exp_dir, metrics_history=history)
@@ -151,12 +193,10 @@ class JaxTrainer:
 
     # -- Tune integration (reference: BaseTrainer.as_trainable) ---------
     def as_trainable(self) -> Callable[[Optional[dict]], Result]:
+        trainer = self
+
         def trainable(config: Optional[dict] = None) -> Result:
-            if config:
-                merged = dict(self._train_config or {})
-                merged.update(config)
-                self._train_config = merged
-            return self.fit()
+            return trainer._run(config)
 
         trainable.__name__ = "JaxTrainer"
         return trainable

@@ -1,0 +1,205 @@
+"""TuneController: the experiment event loop.
+
+Reference equivalent: `python/ray/tune/execution/tune_controller.py:73`
+(`step :716`, actor scheduling `:1021`, result processing `:1526`, save
+`:1747`) over the air/execution actor manager. Here each trial runs in a
+`_TrialRunner` actor (the TrainWorker session machinery reused at
+world_size=1); the controller keeps one outstanding `next_result` ref per
+running trial and multiplexes with `ray_tpu.wait`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.exceptions import RayActorError, RayTaskError
+from ray_tpu.train._internal.worker_group import TrainWorker
+from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+from ray_tpu.tune.trial import (ERROR, PENDING, RUNNING, TERMINATED, Trial)
+
+logger = logging.getLogger(__name__)
+
+
+class _TrialRunner(TrainWorker):
+    """Actor body hosting one trial's trainable function."""
+
+
+class TuneController:
+    def __init__(self, trainable: Callable, trials: List[Trial], *,
+                 exp_dir: str,
+                 scheduler: Optional[TrialScheduler] = None,
+                 max_concurrent: int = 0,
+                 trial_resources: Optional[Dict[str, float]] = None):
+        import cloudpickle
+
+        self._trainable_blob = cloudpickle.dumps(trainable)
+        self.trials = trials
+        self.exp_dir = exp_dir
+        self.scheduler = scheduler or FIFOScheduler()
+        self.max_concurrent = max_concurrent or 4
+        self._trial_resources = dict(trial_resources or {"CPU": 0.0})
+        self._actors: Dict[str, Any] = {}       # trial_id -> actor handle
+        self._inflight: Dict[Any, Trial] = {}   # next_result ref -> trial
+        os.makedirs(exp_dir, exist_ok=True)
+
+    # -- lifecycle ------------------------------------------------------
+    def run(self) -> List[Trial]:
+        try:
+            while not self._finished():
+                self._launch_pending()
+                self._process_events()
+                self.save_state()
+        finally:
+            self._cleanup()
+            self.save_state()
+        return self.trials
+
+    def _finished(self) -> bool:
+        return all(t.status in (TERMINATED, ERROR) for t in self.trials)
+
+    # -- scheduling -----------------------------------------------------
+    def _launch_pending(self) -> None:
+        running = sum(1 for t in self.trials if t.status == RUNNING)
+        for trial in self.trials:
+            if running >= self.max_concurrent:
+                break
+            if trial.status != PENDING:
+                continue
+            self._start_trial(trial)
+            running += 1
+
+    def _start_trial(self, trial: Trial) -> None:
+        num_cpus = self._trial_resources.get("CPU", 0.0)
+        extras = {k: v for k, v in self._trial_resources.items()
+                  if k != "CPU" and v}
+        opts: Dict[str, Any] = {"num_cpus": num_cpus, "max_concurrency": 8}
+        if extras:
+            opts["resources"] = extras
+        actor = ray_tpu.remote(**opts)(_TrialRunner).remote()
+        checkpoint = None
+        if trial.checkpoint_dir and os.path.isdir(trial.checkpoint_dir):
+            from ray_tpu.air.checkpoint import Checkpoint
+
+            checkpoint = Checkpoint.from_directory(trial.checkpoint_dir)
+        ray_tpu.get(actor.start_training.remote(
+            self._trainable_blob, trial.config, world_rank=0, local_rank=0,
+            world_size=1, node_rank=0, trial_name=trial.trial_id,
+            checkpoint=checkpoint), timeout=120)
+        trial.status = RUNNING
+        self._actors[trial.trial_id] = actor
+        self._poll(trial)
+        logger.info("trial %s started: %s", trial.trial_id, trial.config)
+
+    def _poll(self, trial: Trial) -> None:
+        actor = self._actors[trial.trial_id]
+        ref = actor.next_result.remote()
+        self._inflight[ref] = trial
+
+    # -- event processing ----------------------------------------------
+    def _process_events(self) -> None:
+        if not self._inflight:
+            time.sleep(0.05)
+            return
+        ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                timeout=1.0)
+        for ref in ready:
+            trial = self._inflight.pop(ref)
+            try:
+                result = ray_tpu.get(ref, timeout=30)
+            except (RayActorError, RayTaskError) as e:
+                self._on_trial_error(trial, e)
+                continue
+            if result.get("type") == "done":
+                self._on_trial_done(trial, result)
+            else:
+                self._on_trial_report(trial, result)
+
+    def _on_trial_report(self, trial: Trial, result: Dict[str, Any]
+                         ) -> None:
+        trial.iterations += 1
+        metrics = dict(result.get("metrics", {}))
+        metrics.setdefault("training_iteration", trial.iterations)
+        metrics.setdefault("trial_id", trial.trial_id)
+        trial.last_result = metrics
+        ckpt = result.get("checkpoint")
+        if ckpt is not None:
+            trial.checkpoint_dir = self._persist_checkpoint(trial, ckpt)
+        decision = self.scheduler.on_trial_result(trial, metrics)
+        if decision == TrialScheduler.STOP:
+            logger.info("trial %s stopped by scheduler at iter %d",
+                        trial.trial_id, trial.iterations)
+            self._stop_trial(trial, TERMINATED)
+        else:
+            self._poll(trial)
+
+    def _on_trial_done(self, trial: Trial, result: Dict[str, Any]) -> None:
+        trial.status = TERMINATED
+        trial.final = result.get("final")
+        self.scheduler.on_trial_complete(trial, trial.last_result)
+        self._teardown_actor(trial)
+
+    def _on_trial_error(self, trial: Trial, exc: BaseException) -> None:
+        logger.warning("trial %s failed: %s", trial.trial_id, exc)
+        trial.status = ERROR
+        trial.error = str(exc)
+        self._teardown_actor(trial)
+
+    def _stop_trial(self, trial: Trial, status: str) -> None:
+        actor = self._actors.get(trial.trial_id)
+        if actor is not None:
+            try:
+                actor.stop_training.remote()
+            except Exception:
+                pass
+        trial.status = status
+        self.scheduler.on_trial_complete(trial, trial.last_result)
+        # Drop any still-inflight ref for this trial.
+        for ref, t in list(self._inflight.items()):
+            if t is trial:
+                del self._inflight[ref]
+        self._teardown_actor(trial)
+
+    def _teardown_actor(self, trial: Trial) -> None:
+        actor = self._actors.pop(trial.trial_id, None)
+        if actor is not None:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+        for ref, t in list(self._inflight.items()):
+            if t is trial:
+                del self._inflight[ref]
+
+    def _cleanup(self) -> None:
+        for trial in self.trials:
+            if trial.status == RUNNING:
+                self._stop_trial(trial, TERMINATED)
+
+    # -- persistence (reference: execution/experiment_state.py) ---------
+    def _persist_checkpoint(self, trial: Trial, ckpt) -> str:
+        path = os.path.join(self.exp_dir, trial.trial_id,
+                            f"checkpoint_{trial.iterations:06d}")
+        ckpt.to_directory(path)
+        return path
+
+    def save_state(self) -> None:
+        state = {"trials": [t.to_state() for t in self.trials],
+                 "timestamp": time.time()}
+        tmp = os.path.join(self.exp_dir, ".tuner_state.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f, default=str)
+        os.replace(tmp, os.path.join(self.exp_dir, "tuner_state.json"))
+
+    @staticmethod
+    def load_state(exp_dir: str) -> Optional[List[Trial]]:
+        path = os.path.join(exp_dir, "tuner_state.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            state = json.load(f)
+        return [Trial.from_state(s) for s in state["trials"]]

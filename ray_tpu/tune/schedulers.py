@@ -1,0 +1,76 @@
+"""Trial schedulers: FIFO and Async Successive Halving (ASHA).
+
+Reference equivalent: `python/ray/tune/schedulers/trial_scheduler.py` +
+`async_hyperband.py` (AsyncHyperBandScheduler / ASHAScheduler): rungs at
+grace_period * reduction_factor^k; at each rung a trial continues only if
+its metric is in the top 1/reduction_factor of results recorded there.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    STOP = "STOP"
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        return self.CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[Dict[str, Any]]
+                          ) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion (reference: trial_scheduler.py)."""
+
+
+class ASHAScheduler(TrialScheduler):
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4,
+                 time_attr: str = "training_iteration"):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung milestone -> recorded metric values (sign-normalized: higher
+        # is always better internally)
+        self.rungs: Dict[int, List[float]] = {}
+        milestone = grace_period
+        while milestone < max_t:
+            self.rungs[milestone] = []
+            milestone *= reduction_factor
+        self._trial_rungs: Dict[str, set] = {}
+
+    def _norm(self, value: float) -> float:
+        return value if self.mode == "max" else -value
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        metric = self.metric
+        if metric is None or metric not in result:
+            return self.CONTINUE
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return self.STOP
+        value = self._norm(float(result[metric]))
+        seen = self._trial_rungs.setdefault(trial.trial_id, set())
+        decision = self.CONTINUE
+        for milestone in sorted(self.rungs):
+            if t < milestone or milestone in seen:
+                continue
+            seen.add(milestone)
+            recorded = self.rungs[milestone]
+            recorded.append(value)
+            if len(recorded) >= self.rf:
+                # Top 1/rf cutoff among everything recorded at this rung.
+                cutoff = sorted(recorded, reverse=True)[
+                    max(len(recorded) // self.rf - 1, 0)]
+                if value < cutoff:
+                    decision = self.STOP
+        return decision

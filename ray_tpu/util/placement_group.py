@@ -35,20 +35,26 @@ class PlacementGroup:
 
     def ready(self):
         """ObjectRef that resolves when the group is placed — `get(pg.
-        ready())` mirrors the reference's await-style readiness check."""
+        ready())` mirrors the reference's await-style readiness check.
+        Returns immediately; raises now only if the group is already in a
+        terminal failed state."""
         import ray_tpu
 
-        pg_id = self.id
+        info = placement_group_table(self) or {}
+        if info.get("state") in ("REMOVED", "INFEASIBLE"):
+            raise ValueError(
+                f"placement group {self.id} is {info.get('state')}: "
+                f"{info.get('detail', '')}")
 
         @ray_tpu.remote(num_cpus=0)
         def _pg_ready() -> bool:
             return True
 
         # Scheduling the probe task inside bundle 0 proves the reservation
-        # is live end-to-end (lease from the bundle, not just table state).
-        self.wait(timeout_seconds=None)
+        # is live end-to-end (lease from the bundle, not just table state);
+        # the submission path itself waits for CREATED.
         return _pg_ready.options(
-            placement_group=pg_id,
+            placement_group=self.id,
             placement_group_bundle_index=0).remote()
 
     def wait(self, timeout_seconds: Optional[float] = 30.0) -> bool:
@@ -120,7 +126,10 @@ def tpu_slice_placement_group(
         if (accelerator_type and
                 labels.get("ray_tpu.accelerator_type") != accelerator_type):
             continue
-        if node.get("Resources", {}).get("TPU", 0) < chips_per_host:
+        # Judge hosts by AVAILABLE chips: a slice whose chips are already
+        # leased must not shadow a free slice.
+        avail = node.get("Available") or node.get("Resources", {})
+        if avail.get("TPU", 0) < chips_per_host:
             continue
         slices.setdefault(name, []).append(node)
 

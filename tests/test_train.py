@@ -187,6 +187,7 @@ def test_training_error_surfaces(ray_cluster):
         jax_config=JaxConfig(platform="cpu"),
         scaling_config=ScalingConfig(num_workers=2),
         run_config=RunConfig(name="err", storage_path="/tmp/rt_train"))
-    result = trainer.fit()
-    assert isinstance(result.error, TrainingFailedError)
-    assert "boom" in str(result.error)
+    # fit() raises after exhausting max_failures (reference:
+    # base_trainer.py TrainingFailed semantics), not a silent Result.error.
+    with pytest.raises(TrainingFailedError, match="boom"):
+        trainer.fit()

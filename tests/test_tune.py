@@ -1,0 +1,214 @@
+"""Tune: controller, searchers, ASHA, experiment resume.
+
+Reference coverage class: python/ray/tune/tests/test_tune_restore.py +
+test_trial_scheduler.py, on a real multi-process cluster.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=6, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_grid_search_finds_best(ray_cluster, tmp_path):
+    from ray_tpu import tune
+
+    def objective(config):
+        for i in range(3):
+            tune.report({"loss": (config["x"] - 3) ** 2 + 0.1 * i})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=__import__("ray_tpu.air.config", fromlist=["RunConfig"])
+        .RunConfig(name="grid", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 4
+    assert grid.num_terminated == 4 and grid.num_errors == 0
+    best = grid.get_best_result()
+    assert best.config["x"] == 3
+    # every trial ran to completion under FIFO
+    assert all(t.iterations == 3 for t in [grid[i] for i in range(4)])
+
+
+def test_asha_early_stops_bad_trials(ray_cluster, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.air.config import RunConfig
+
+    def objective(config):
+        # score grows linearly with rate `lr`: low-lr trials are provably
+        # worse at every rung and must be culled.
+        for i in range(1, 21):
+            tune.report({"score": config["lr"] * i})
+
+    tuner = tune.Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.01, 0.1, 1.0, 10.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max",
+            scheduler=tune.ASHAScheduler(max_t=20, grace_period=2,
+                                         reduction_factor=2),
+            max_concurrent_trials=4),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert grid.num_errors == 0
+    best = grid.get_best_result()
+    assert best.config["lr"] == 10.0
+    iters = sorted(grid[i].iterations for i in range(4))
+    assert iters[0] < 20, f"ASHA never stopped anything early: {iters}"
+    assert iters[-1] == 20, f"the best trial should run to max_t: {iters}"
+
+
+def test_error_trial_recorded(ray_cluster, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.air.config import RunConfig
+
+    def objective(config):
+        if config["x"] == 2:
+            raise RuntimeError("bad trial")
+        tune.report({"loss": config["x"]})
+
+    grid = tune.Tuner(
+        objective, param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(name="err", storage_path=str(tmp_path))).fit()
+    assert grid.num_errors == 1 and grid.num_terminated == 1
+    assert grid.get_best_result().config["x"] == 1
+
+
+_RESUME_DRIVER = """
+import sys
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import RunConfig
+
+sys.path.insert(0, {test_dir!r})
+from test_tune import _resume_objective
+
+ray_tpu.init(address={address!r})
+tune.Tuner(
+    _resume_objective,
+    param_space={{"kind": tune.grid_search(["fast", "fast", "slow",
+                                            "slow"])}},
+    tune_config=tune.TuneConfig(metric="step", mode="max",
+                                max_concurrent_trials=4),
+    run_config=RunConfig(name="resume", storage_path={storage!r})).fit()
+"""
+
+
+def _resume_objective(config):
+    import time as _t
+
+    from ray_tpu import tune
+    from ray_tpu.air.checkpoint import Checkpoint
+
+    ckpt = tune.get_checkpoint()
+    start = ckpt.to_dict()["step"] + 1 if ckpt is not None else 0
+    steps = 3 if config["kind"] == "fast" else 40
+    for step in range(start, steps):
+        tune.report({"step": step, "resumed_from": start},
+                    checkpoint=Checkpoint.from_dict({"step": step}))
+        if config["kind"] == "slow":
+            _t.sleep(0.4)
+
+
+def test_experiment_resume_after_driver_death(ray_cluster, tmp_path):
+    """Hard-kill the tuning driver mid-experiment; Tuner.restore finishes:
+    completed trials keep their results (not rerun), interrupted trials
+    resume from their latest trial checkpoint."""
+    import ray_tpu
+    from ray_tpu import tune
+
+    storage = str(tmp_path)
+    exp_dir = os.path.join(storage, "resume")
+    from ray_tpu.core.worker import current_runtime
+
+    script = _RESUME_DRIVER.format(
+        test_dir=os.path.dirname(os.path.abspath(__file__)),
+        address=current_runtime().gcs_address,
+        storage=storage)
+    proc = subprocess.Popen([sys.executable, "-c", script],
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.STDOUT)
+    # Wait until both fast trials finished AND the slow ones checkpointed.
+    state_path = os.path.join(exp_dir, "tuner_state.json")
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        try:
+            with open(state_path) as f:
+                trials = json.load(f)["trials"]
+            done = [t for t in trials if t["status"] == "TERMINATED"]
+            slow_progress = [t for t in trials
+                             if t["status"] == "RUNNING"
+                             and t["iterations"] >= 3]
+            if len(done) >= 2 and len(slow_progress) >= 2:
+                break
+        except (FileNotFoundError, json.JSONDecodeError, KeyError):
+            pass
+        time.sleep(0.25)
+    else:
+        proc.kill()
+        pytest.fail("experiment never reached the kill point")
+    proc.kill()
+    proc.wait()
+
+    grid = tune.Tuner.restore(
+        exp_dir, _resume_objective,
+        tune_config=tune.TuneConfig(metric="step", mode="max")).fit()
+    assert grid.num_errors == 0
+    assert grid.num_terminated == 4
+    fast = [grid[i] for i in range(4) if grid[i].config["kind"] == "fast"]
+    slow = [grid[i] for i in range(4) if grid[i].config["kind"] == "slow"]
+    # completed trials kept their pre-crash results
+    assert all(t.last_result["step"] == 2 for t in fast)
+    # interrupted trials resumed from a checkpoint, not step 0
+    assert all(t.last_result["step"] == 39 for t in slow)
+    assert all(t.last_result["resumed_from"] > 0 for t in slow), \
+        [t.last_result for t in slow]
+
+
+def test_jax_trainer_via_tuner(ray_cluster, tmp_path):
+    """JaxTrainer.as_trainable rides the Tune controller: tuning lr over a
+    real 2-worker gang per trial (reference: trainers are Tune jobs)."""
+    from ray_tpu import tune
+    from ray_tpu.air.config import RunConfig, ScalingConfig
+    from ray_tpu.train import JaxConfig, JaxTrainer
+
+    def loop(config):
+        from ray_tpu import train
+
+        for i in range(3):
+            train.report({"loss": config["lr"] * (i + 1),
+                          "world": train.get_world_size()})
+
+    trainer = JaxTrainer(
+        loop,
+        jax_config=JaxConfig(platform="cpu"),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="tune_gang", storage_path=str(tmp_path)))
+    grid = tune.Tuner(
+        trainer,
+        param_space={"lr": tune.grid_search([0.1, 0.2])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    max_concurrent_trials=1),
+        run_config=RunConfig(name="tune_gang_exp",
+                             storage_path=str(tmp_path))).fit()
+    assert grid.num_terminated == 2, [grid[i].error for i in range(2)]
+    best = grid.get_best_result()
+    assert best.config["lr"] == 0.1
+    assert best.last_result["world"] == 2
