@@ -1113,7 +1113,10 @@ class ClusterRuntime:
 
         info = self._pg_cache.get(pg_id)
         if info is None or info.get("state") != "CREATED":
-            deadline = time.monotonic() + 60.0
+            # No deadline while PENDING: the owner-side scheduler always
+            # terminates in CREATED or INFEASIBLE after bounded attempts,
+            # and a lease must tolerate slow placement (hosts still
+            # registering) the way the reference's pending-PG tasks do.
             while True:
                 info = await self._gcs.get_placement_group(pg_id)
                 state = (info or {}).get("state")
@@ -1125,10 +1128,7 @@ class ClusterRuntime:
                         f"placement group {pg_id} is unusable "
                         f"(state={state}: "
                         f"{(info or {}).get('detail', '')})")
-                if time.monotonic() >= deadline:
-                    raise ValueError(
-                        f"placement group {pg_id} not ready within 60s")
-                await asyncio.sleep(0.05)
+                await asyncio.sleep(0.1)
         locs = info["bundle_locations"]
         if bundle_index is None or bundle_index < 0:
             specs = info.get("bundles", [])

@@ -1,0 +1,16 @@
+"""ray_tpu.data — streaming datasets for SPMD ingest.
+
+Reference equivalent: `python/ray/data/` (Dataset, read_api, streaming
+executor). Blocks are dict-of-numpy batches, executed lazily through a
+bounded-window task pool; `split_for_workers` gives each training worker a
+disjoint shard (`session.get_dataset_shard`).
+"""
+
+from ray_tpu.data.block import Block
+from ray_tpu.data.dataset import (Dataset, from_items, from_numpy, range,
+                                  read_csv, read_parquet)
+
+__all__ = [
+    "Block", "Dataset", "range", "from_items", "from_numpy",
+    "read_csv", "read_parquet",
+]

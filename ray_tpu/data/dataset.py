@@ -1,0 +1,209 @@
+"""Dataset: lazy, streaming, shardable.
+
+Reference equivalent: `python/ray/data/dataset.py` (user surface) +
+`_internal/plan.py` (lazy plan). A Dataset is a list of read tasks plus a
+chain of block transforms; nothing executes until iteration. Sharding for
+SPMD ingest (`split_for_workers`) partitions the read tasks round-robin, so
+every training worker owns a disjoint file/block subset — the reference's
+`DataConfig.get_dataset_shards` per-host sharding.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import (Block, block_from_rows, block_num_rows,
+                                block_to_rows, concat_blocks, rebatch)
+from ray_tpu.data.executor import StreamingExecutor
+
+
+class Dataset:
+    def __init__(self, read_tasks: List[Callable[[], Block]],
+                 transforms: Optional[List[Callable[[Block], Block]]] = None):
+        self._read_tasks = read_tasks
+        self._transforms = list(transforms or [])
+
+    # -- transforms (lazy) ----------------------------------------------
+    def map_batches(self, fn: Callable[[Block], Block],
+                    **_ignored: Any) -> "Dataset":
+        return Dataset(self._read_tasks, self._transforms + [fn])
+
+    def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]
+            ) -> "Dataset":
+        def _map_block(block: Block) -> Block:
+            return block_from_rows([fn(r) for r in block_to_rows(block)])
+
+        return self.map_batches(_map_block)
+
+    def filter(self, fn: Callable[[Dict[str, Any]], bool]) -> "Dataset":
+        def _filter_block(block: Block) -> Block:
+            rows = [r for r in block_to_rows(block) if fn(r)]
+            return block_from_rows(rows)
+
+        return self.map_batches(_filter_block)
+
+    # -- execution ------------------------------------------------------
+    def _executor(self, max_in_flight: int = 4) -> StreamingExecutor:
+        return StreamingExecutor(self._read_tasks, self._transforms,
+                                 max_in_flight=max_in_flight)
+
+    def iter_blocks(self, max_in_flight: int = 4) -> Iterator[Block]:
+        import ray_tpu
+
+        ex = self._executor(max_in_flight)
+        if ray_tpu.is_initialized():
+            return iter(ex)
+        return ex.run_local()
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     prefetch_blocks: int = 4,
+                     drop_last: bool = False) -> Iterator[Block]:
+        it = rebatch(self.iter_blocks(max_in_flight=prefetch_blocks),
+                     batch_size)
+        if not drop_last or batch_size is None:
+            return it
+        return (b for b in it if block_num_rows(b) == batch_size)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for block in self.iter_blocks():
+            yield from block_to_rows(block)
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def count(self) -> int:
+        return sum(block_num_rows(b) for b in self.iter_blocks())
+
+    def materialize(self) -> Block:
+        return concat_blocks(list(self.iter_blocks()))
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        for block in self.iter_blocks(max_in_flight=1):
+            if block:
+                return {c: str(v.dtype) for c, v in block.items()}
+        return None
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._read_tasks)
+
+    # -- sharding (reference: DataConfig per-worker shards) --------------
+    def split(self, n: int) -> List["Dataset"]:
+        # builtins.range: the module-level `range` is the Dataset factory.
+        return [Dataset(self._read_tasks[i::n], self._transforms)
+                for i in builtins.range(n)]
+
+    def split_for_workers(self, n: int) -> List["Dataset"]:
+        if len(self._read_tasks) < n:
+            raise ValueError(
+                f"cannot shard {len(self._read_tasks)} block(s) across "
+                f"{n} workers; increase parallelism/file count")
+        return self.split(n)
+
+    def __repr__(self) -> str:
+        return (f"Dataset(num_blocks={self.num_blocks}, "
+                f"num_transforms={len(self._transforms)})")
+
+
+# ---------------------------------------------------------------------
+# datasources (reference: python/ray/data/read_api.py + datasource/)
+# ---------------------------------------------------------------------
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    parallelism = max(1, min(parallelism, n or 1))
+    bounds = np.linspace(0, n, parallelism + 1, dtype=np.int64)
+
+    def make_task(lo: int, hi: int) -> Callable[[], Block]:
+        return lambda: {"id": np.arange(lo, hi, dtype=np.int64)}
+
+    return Dataset([make_task(int(bounds[i]), int(bounds[i + 1]))
+                    for i in builtins.range(parallelism)])
+
+
+def from_items(items: List[Any], *, parallelism: int = 4) -> Dataset:
+    items = list(items)
+    parallelism = max(1, min(parallelism, len(items) or 1))
+    chunks = np.array_split(np.arange(len(items)), parallelism)
+
+    def make_task(idx: np.ndarray) -> Callable[[], Block]:
+        rows = [items[i] for i in idx]
+        if rows and isinstance(rows[0], dict):
+            return lambda: block_from_rows(rows)
+        return lambda: {"item": np.asarray(rows)}
+
+    return Dataset([make_task(c) for c in chunks if len(c)])
+
+
+def from_numpy(arrays: Dict[str, np.ndarray], *,
+               parallelism: int = 4) -> Dataset:
+    n = len(next(iter(arrays.values())))
+    parallelism = max(1, min(parallelism, n or 1))
+    bounds = np.linspace(0, n, parallelism + 1, dtype=np.int64)
+
+    def make_task(lo: int, hi: int) -> Callable[[], Block]:
+        part = {c: v[lo:hi] for c, v in arrays.items()}
+        return lambda: part
+
+    return Dataset([make_task(int(bounds[i]), int(bounds[i + 1]))
+                    for i in builtins.range(parallelism)])
+
+
+def _expand_paths(paths) -> List[str]:
+    import glob
+    import os
+
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if not f.startswith(".")))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return out
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    """One read task per file (reference: datasource/parquet_datasource)."""
+    files = _expand_paths(paths)
+
+    def make_task(path: str) -> Callable[[], Block]:
+        def read() -> Block:
+            import pyarrow.parquet as pq
+
+            table = pq.read_table(path, columns=columns)
+            return {c: table[c].to_numpy(zero_copy_only=False)
+                    for c in table.column_names}
+
+        return read
+
+    return Dataset([make_task(f) for f in files])
+
+
+def read_csv(paths, **read_kwargs: Any) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make_task(path: str) -> Callable[[], Block]:
+        def read() -> Block:
+            import pyarrow.csv as pacsv
+
+            table = pacsv.read_csv(path, **read_kwargs)
+            return {c: table[c].to_numpy(zero_copy_only=False)
+                    for c in table.column_names}
+
+        return read
+
+    return Dataset([make_task(f) for f in files])

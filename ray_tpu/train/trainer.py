@@ -129,9 +129,13 @@ class JaxTrainer:
                         self._prune_checkpoints(exp_dir)
                     if tune_session is not None:
                         # Forward the round to the Tune controller: it
-                        # records progress, persists the trial checkpoint,
-                        # and may raise _StopTraining (scheduler stop).
-                        tune_session.report(metrics, checkpoint=ckpt)
+                        # records progress, records the trial checkpoint
+                        # (the already-persisted dir-backed one — no second
+                        # copy), and may raise _StopTraining.
+                        tune_session.report(
+                            metrics,
+                            checkpoint=(latest_ckpt if ckpt is not None
+                                        else None))
                 last = history[-1] if history else {}
                 return Result(metrics=last, checkpoint=latest_ckpt,
                               path=exp_dir, metrics_history=history)

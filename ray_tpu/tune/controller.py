@@ -41,7 +41,9 @@ class TuneController:
         self.trials = trials
         self.exp_dir = exp_dir
         self.scheduler = scheduler or FIFOScheduler()
-        self.max_concurrent = max_concurrent or 4
+        # 0 = unlimited (reference: TuneConfig.max_concurrent_trials).
+        self.max_concurrent = (max_concurrent if max_concurrent > 0
+                               else 10 ** 9)
         self._trial_resources = dict(trial_resources or {"CPU": 0.0})
         self._actors: Dict[str, Any] = {}       # trial_id -> actor handle
         self._inflight: Dict[Any, Trial] = {}   # next_result ref -> trial
@@ -49,13 +51,18 @@ class TuneController:
 
     # -- lifecycle ------------------------------------------------------
     def run(self) -> List[Trial]:
+        interrupted = True
         try:
             while not self._finished():
                 self._launch_pending()
                 self._process_events()
                 self.save_state()
+            interrupted = False
         finally:
-            self._cleanup()
+            # On interruption (Ctrl+C / escaping error) trials stay RUNNING
+            # in the snapshot so Tuner.restore reruns them; marking them
+            # TERMINATED here would fake completion with partial results.
+            self._cleanup(keep_status=interrupted)
             self.save_state()
         return self.trials
 
@@ -70,7 +77,12 @@ class TuneController:
                 break
             if trial.status != PENDING:
                 continue
-            self._start_trial(trial)
+            try:
+                self._start_trial(trial)
+            except Exception as e:  # noqa: BLE001
+                # One trial failing to start must not abort the experiment.
+                self._on_trial_error(trial, e)
+                continue
             running += 1
 
     def _start_trial(self, trial: Trial) -> None:
@@ -154,14 +166,23 @@ class TuneController:
         if actor is not None:
             try:
                 actor.stop_training.remote()
+                # Let the trainable unwind before the actor dies: a
+                # JaxTrainer trial's _StopTraining path must reach
+                # executor.shutdown() or its gang actors leak. Drain
+                # reports until the loop finishes (bounded).
+                import ray_tpu
+
+                deadline = time.monotonic() + 60.0
+                while time.monotonic() < deadline:
+                    r = ray_tpu.get(actor.next_result.remote(),
+                                    timeout=max(deadline - time.monotonic(),
+                                                1.0))
+                    if r.get("type") == "done":
+                        break
             except Exception:
                 pass
         trial.status = status
         self.scheduler.on_trial_complete(trial, trial.last_result)
-        # Drop any still-inflight ref for this trial.
-        for ref, t in list(self._inflight.items()):
-            if t is trial:
-                del self._inflight[ref]
         self._teardown_actor(trial)
 
     def _teardown_actor(self, trial: Trial) -> None:
@@ -175,16 +196,32 @@ class TuneController:
             if t is trial:
                 del self._inflight[ref]
 
-    def _cleanup(self) -> None:
+    def _cleanup(self, keep_status: bool = False) -> None:
         for trial in self.trials:
             if trial.status == RUNNING:
-                self._stop_trial(trial, TERMINATED)
+                if keep_status:
+                    self._teardown_actor(trial)  # snapshot keeps RUNNING
+                else:
+                    self._stop_trial(trial, TERMINATED)
 
     # -- persistence (reference: execution/experiment_state.py) ---------
     def _persist_checkpoint(self, trial: Trial, ckpt) -> str:
-        path = os.path.join(self.exp_dir, trial.trial_id,
+        # Already directory-backed (e.g. a JaxTrainer forwarding its own
+        # persisted checkpoint): record the path, don't copy it again.
+        existing = getattr(ckpt, "path", None)
+        if existing and os.path.isdir(existing):
+            return existing
+        trial_dir = os.path.join(self.exp_dir, trial.trial_id)
+        path = os.path.join(trial_dir,
                             f"checkpoint_{trial.iterations:06d}")
         ckpt.to_directory(path)
+        # Resume only ever needs the latest; prune older copies.
+        import shutil
+
+        kept = sorted(d for d in os.listdir(trial_dir)
+                      if d.startswith("checkpoint_"))
+        for d in kept[:-2]:
+            shutil.rmtree(os.path.join(trial_dir, d), ignore_errors=True)
         return path
 
     def save_state(self) -> None:
