@@ -155,6 +155,10 @@ class ClusterRuntime:
         self._job_env_lock = threading.Lock()
         self._pg_cache: Dict[str, dict] = {}
         self._pg_rr: Dict[str, int] = {}
+        # Lineage: return-oid -> shared task record, kept while any return
+        # ref lives so lost objects can be re-executed (reference:
+        # task_manager.h:424 RetryTaskIfPossible + lineage pinning).
+        self._lineage: Dict[str, dict] = {}
         if mode == "driver":
             import sys
             # sys_path lets workers import driver-local modules (test files,
@@ -259,6 +263,14 @@ class ClusterRuntime:
                 return
             del self._owned[oid]
             nodes = list(entry.nodes)
+        rec = self._lineage.pop(oid, None)
+        if rec is not None:
+            rec["live"] -= 1
+            if rec["live"] <= 0:
+                # Last return ref gone: lineage no longer needs the task's
+                # argument objects pinned.
+                self._unpin_args(rec["pinned"])
+                rec["pinned"] = []
         if nodes:
             async def _delete():
                 for addr in nodes:
@@ -456,7 +468,18 @@ class ClusterRuntime:
         if streaming:
             gen = ObjectRefGenerator()
             self._generators[task_id.hex()] = gen
-        self._loop.spawn(self._submit_async(spec, refs, pinned))
+        retain = (not streaming and opts.num_returns != 0
+                  and opts.max_retries > 0)
+        if retain:
+            # Retain the spec (and keep its arg refs pinned) for lineage
+            # re-execution; released when the last return ref is freed.
+            rec = {"spec": spec, "ref_oids": [r.hex() for r in refs],
+                   "pinned": pinned, "left": max(opts.max_retries, 0),
+                   "live": len(refs), "inflight": False}
+            for r in refs:
+                self._lineage[r.hex()] = rec
+        self._loop.spawn(self._submit_async(
+            spec, refs, pinned if not retain else None))
         if streaming:
             return gen
         if opts.num_returns == 0:
@@ -1113,10 +1136,11 @@ class ClusterRuntime:
 
         info = self._pg_cache.get(pg_id)
         if info is None or info.get("state") != "CREATED":
-            # No deadline while PENDING: the owner-side scheduler always
-            # terminates in CREATED or INFEASIBLE after bounded attempts,
-            # and a lease must tolerate slow placement (hosts still
-            # registering) the way the reference's pending-PG tasks do.
+            # Generous deadline: the owner-side scheduler terminates in
+            # CREATED or INFEASIBLE after bounded attempts — but if the
+            # owner process died mid-scheduling the record stays PENDING
+            # forever, so don't spin unbounded on someone else's PG.
+            deadline = time.monotonic() + 300.0
             while True:
                 info = await self._gcs.get_placement_group(pg_id)
                 state = (info or {}).get("state")
@@ -1128,6 +1152,10 @@ class ClusterRuntime:
                         f"placement group {pg_id} is unusable "
                         f"(state={state}: "
                         f"{(info or {}).get('detail', '')})")
+                if time.monotonic() >= deadline:
+                    raise ValueError(
+                        f"placement group {pg_id} stuck PENDING for 300s "
+                        "(owner died mid-scheduling?)")
                 await asyncio.sleep(0.1)
         locs = info["bundle_locations"]
         if bundle_index is None or bundle_index < 0:
@@ -1184,12 +1212,58 @@ class ClusterRuntime:
 
     async def handle_prune_object_location(self, conn: ServerConnection, *,
                                            oid: str, node: str) -> bool:
-        """A raylet discovered `node` no longer holds `oid` (evicted): drop
-        the stale location from the owner-side directory."""
+        """A raylet discovered `node` no longer holds `oid` (evicted or
+        died): drop the stale location; when the LAST copy is gone,
+        re-execute the producing task if its lineage is retained
+        (reference: object_recovery_manager.h:41)."""
+        lost = False
         with self._owned_lock:
             entry = self._owned.get(oid)
             if entry is not None and node in entry.nodes:
                 entry.nodes.remove(node)
+                lost = not entry.nodes and entry.is_stored
+        if lost:
+            self._trigger_reconstruction(oid)
+        return True
+
+    def _trigger_reconstruction(self, oid: str) -> bool:
+        """Re-execute the task that produced `oid` (owner-side; runs on the
+        RPC loop). Pullers observing `pending` keep waiting meanwhile."""
+        rec = self._lineage.get(oid)
+        if rec is None or rec["inflight"]:
+            return rec is not None and rec["inflight"]
+        if rec["left"] <= 0:
+            logger.warning("object %s lost and reconstruction budget "
+                           "exhausted", oid[:16])
+            return False
+        rec["inflight"] = True
+        rec["left"] -= 1
+        refs = []
+        with self._owned_lock:
+            for roid in rec["ref_oids"]:
+                entry = self._owned.get(roid)
+                if entry is None:
+                    continue
+                if entry.is_stored and entry.nodes:
+                    continue  # sibling return with healthy copies: keep it
+                # Reset to pending: directory answers "pending" until the
+                # re-executed task stores fresh copies.
+                entry.fut = concurrent.futures.Future()
+                entry.nodes = []
+                entry.is_stored = False
+        for roid in rec["ref_oids"]:
+            refs.append(ObjectRef(ObjectID(bytes.fromhex(roid)),
+                                  owner=self.address, runtime=self))
+        logger.info("reconstructing %s via re-execution of %s (%d budget "
+                    "left)", oid[:16], rec["spec"].get("name"), rec["left"])
+
+        async def _resubmit():
+            try:
+                await self._submit_async(rec["spec"], refs, None)
+            finally:
+                rec["inflight"] = False
+
+        self._loop.spawn(_resubmit())
         return True
 
     async def handle_ping(self, conn: ServerConnection) -> str:

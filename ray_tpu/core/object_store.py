@@ -200,14 +200,25 @@ class WorkerStoreClient:
             self._attached[shm_name] = shm
         return shm.buf[:size]
 
+    # Mappings whose buffers are still referenced by deserialized
+    # zero-copy arrays at close time: kept alive for process lifetime so
+    # neither close() nor GC raises BufferError (OS reclaims at exit).
+    _leaked: list = []
+
     def release(self, shm_name: str) -> None:
         shm = self._attached.pop(shm_name, None)
         if shm is not None:
-            shm.close()
+            try:
+                shm.close()
+            except BufferError:
+                self._leaked.append(shm)
 
     def close(self) -> None:
         for shm in self._attached.values():
-            shm.close()
+            try:
+                shm.close()
+            except BufferError:
+                self._leaked.append(shm)
         self._attached.clear()
 
 

@@ -157,7 +157,30 @@ class Raylet:
             except Exception:
                 logger.warning("heartbeat to GCS failed", exc_info=True)
             self._reap_stale_prepares()
+            self._spill_infeasible_pending()
             await asyncio.sleep(period)
+
+    def _spill_infeasible_pending(self) -> None:
+        """Queued leases this node can never satisfy get redirected once
+        the refreshed cluster view shows a viable remote; until then they
+        wait, with a periodic diagnostic (reference: the cluster task
+        manager's 'cannot be scheduled' warning)."""
+        now = time.monotonic()
+        for pending in list(self._pending):
+            if pending.bundle_key is not None:
+                continue
+            if self._feasible_locally(pending.demand):
+                continue
+            remote = self._pick_spillback(pending.demand)
+            if remote is not None and not pending.future.done():
+                self._pending.remove(pending)
+                pending.future.set_result({"spillback": remote})
+            elif now - getattr(pending, "last_warn", 0.0) > 10.0:
+                pending.last_warn = now
+                logger.warning(
+                    "lease demand %s cannot be scheduled: no node in the "
+                    "cluster has these resources (waiting for the cluster "
+                    "to change)", pending.demand)
 
     def _on_node_update(self, data) -> None:
         if not data.get("alive"):
@@ -296,10 +319,10 @@ class Raylet:
                 remote = self._pick_spillback(demand)
                 if remote is not None:
                     return {"spillback": remote}
-        if not local_fits and not self._feasible_locally(demand):
-            return {"error": "infeasible",
-                    "detail": f"demand {demand} exceeds node total "
-                              f"{self.resources_total}"}
+        # Locally-infeasible demands queue rather than fail (reference:
+        # infeasible tasks wait in the cluster task manager until the
+        # cluster changes — e.g. the node with that resource is still
+        # registering); the heartbeat loop re-evaluates them for spillback.
         pending = _PendingLease(demand, is_actor, scheduling_key)
         pending.conn = conn
         self._pending.append(pending)
@@ -618,12 +641,31 @@ class Raylet:
                     return {"inline": loc["inline"]}
                 for node_addr in loc.get("nodes", []):
                     if node_addr == self.address:
+                        # We're listed as a holder but store.info() came up
+                        # empty above: our copy was evicted. Prune it so
+                        # the owner can recover instead of us spinning on
+                        # a stale self-location.
+                        try:
+                            await owner.notify("prune_object_location",
+                                               oid=oid, node=node_addr)
+                        except Exception:
+                            pass
                         continue
                     try:
                         remote = await self._raylet_client(node_addr)
                         data = await remote.call("read_object", oid=oid,
                                                  timeout=60.0)
                     except Exception:
+                        # Unreachable holder: if the cluster has declared
+                        # its node dead, prune the location so the owner
+                        # can start lineage reconstruction; otherwise treat
+                        # it as transient and retry.
+                        if self._address_is_dead(node_addr):
+                            try:
+                                await owner.notify("prune_object_location",
+                                                   oid=oid, node=node_addr)
+                            except Exception:
+                                pass
                         continue
                     if data is not None:
                         self.store.put_bytes(oid, data)
@@ -637,10 +679,18 @@ class Raylet:
                                            oid=oid, node=node_addr)
                     except Exception:
                         pass
-                if not loc.get("pending"):
+                if not loc.get("pending") and not loc.get("nodes"):
+                    # No copies AND the owner is not producing one (no
+                    # in-flight task, no reconstruction): permanently lost.
                     return {"error": "no reachable copy"}
             await asyncio.sleep(ray_config().object_timeout_ms / 1000.0)
         return {"error": "timeout"}
+
+    def _address_is_dead(self, address: str) -> bool:
+        """True when the GCS view says no alive node serves `address`."""
+        alive = {info.get("address") for info in self._cluster_view.values()
+                 if info.get("alive", True)}
+        return bool(alive) and address not in alive
 
     async def _raylet_client(self, address: str) -> RpcClient:
         client = self._raylet_clients.get(address)

@@ -88,6 +88,11 @@ class JaxTrainer:
         run_name = self._run_config.name or f"JaxTrainer_{int(time.time())}"
         exp_dir = os.path.join(self._run_config.resolved_storage_path(),
                                run_name)
+        if tune_session is not None and tune_session.trial_name:
+            # Per-trial checkpoint dir (reference storage layout:
+            # storage/<experiment>/<trial>/checkpoint_*): concurrent trials
+            # of one tuned trainer must not share checkpoint paths.
+            exp_dir = os.path.join(exp_dir, tune_session.trial_name)
         os.makedirs(exp_dir, exist_ok=True)
 
         max_failures = self._run_config.failure_config.max_failures

@@ -162,16 +162,23 @@ class TuneController:
         self._teardown_actor(trial)
 
     def _stop_trial(self, trial: Trial, status: str) -> None:
-        actor = self._actors.get(trial.trial_id)
-        if actor is not None:
+        actor = self._actors.pop(trial.trial_id, None)
+        trial.status = status
+        self.scheduler.on_trial_complete(trial, trial.last_result)
+        for ref, t in list(self._inflight.items()):
+            if t is trial:
+                del self._inflight[ref]
+        if actor is None:
+            return
+
+        def drain_then_kill():
+            # Off the controller loop: let the trainable unwind before the
+            # actor dies — a JaxTrainer trial's _StopTraining path must
+            # reach executor.shutdown() or its gang actors leak.
+            import ray_tpu
+
             try:
                 actor.stop_training.remote()
-                # Let the trainable unwind before the actor dies: a
-                # JaxTrainer trial's _StopTraining path must reach
-                # executor.shutdown() or its gang actors leak. Drain
-                # reports until the loop finishes (bounded).
-                import ray_tpu
-
                 deadline = time.monotonic() + 60.0
                 while time.monotonic() < deadline:
                     r = ray_tpu.get(actor.next_result.remote(),
@@ -181,9 +188,15 @@ class TuneController:
                         break
             except Exception:
                 pass
-        trial.status = status
-        self.scheduler.on_trial_complete(trial, trial.last_result)
-        self._teardown_actor(trial)
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+
+        import threading
+
+        threading.Thread(target=drain_then_kill, daemon=True,
+                         name=f"stop-{trial.trial_id}").start()
 
     def _teardown_actor(self, trial: Trial) -> None:
         actor = self._actors.pop(trial.trial_id, None)
