@@ -1,0 +1,110 @@
+"""Per-process JAX platform pinning for worker processes.
+
+Reference equivalent: `python/ray/_private/accelerators/tpu.py:214` keeps
+worker processes off accelerators they were not granted via visibility env
+vars. On this stack env vars are not enough: a site-installed PJRT plugin
+(e.g. the tunnel TPU client) may claim the default backend regardless of
+`JAX_PLATFORMS`, so a plain CPU task worker would initialize — and contend
+for — the host's TPU the moment user code imports jax. The only reliable
+switch is `jax.config.update("jax_platforms", ...)` before backends
+initialize, so workers pin lazily: a meta-path hook applies the pin the
+instant `jax` finishes importing, costing nothing for workers that never
+touch jax.
+
+Workers granted TPU chips at lease time undo the pin with
+`enable_host_platform()` (see `cluster_runtime._apply_visible_chips`).
+"""
+
+from __future__ import annotations
+
+import importlib.abc
+import importlib.util
+import os
+import sys
+from typing import Optional
+
+# What platform workers pin to at jax-import time (default: cpu).
+PIN_ENV = "RAY_TPU_WORKER_JAX_PLATFORMS"
+# The host's ambient JAX_PLATFORMS, captured by the node bootstrap BEFORE
+# any defaulting, so a TPU-leased worker can restore it ("" = autodetect).
+HOST_ENV = "RAY_TPU_HOST_JAX_PLATFORMS"
+
+
+class _JaxPlatformPinner(importlib.abc.MetaPathFinder):
+    """Wraps the real jax loader so the platform pin lands immediately
+    after `import jax`, before any backend can initialize."""
+
+    def __init__(self, platform: str):
+        self.platform = platform
+        self._resolving = False
+
+    def find_spec(self, name, path, target=None):
+        if name != "jax" or self._resolving:
+            return None
+        self._resolving = True
+        try:
+            spec = importlib.util.find_spec("jax")
+        finally:
+            self._resolving = False
+        if spec is None or spec.loader is None:
+            return None
+        orig_loader = spec.loader
+        pinner = self
+
+        class _PinningLoader(importlib.abc.Loader):
+            def create_module(self, s):
+                return orig_loader.create_module(s)
+
+            def exec_module(self, module):
+                orig_loader.exec_module(module)
+                try:
+                    module.config.update("jax_platforms", pinner.platform)
+                except Exception:
+                    pass
+                try:
+                    sys.meta_path.remove(pinner)
+                except ValueError:
+                    pass
+
+        spec.loader = _PinningLoader()
+        return spec
+
+
+def pin_worker_platform(platform: Optional[str] = None) -> None:
+    """Install the lazy pin (idempotent). Called from worker_main before
+    any user code runs."""
+    platform = platform or os.environ.get(PIN_ENV, "cpu")
+    if "jax" in sys.modules:
+        try:
+            sys.modules["jax"].config.update("jax_platforms", platform)
+        except Exception:
+            pass
+        return
+    if any(isinstance(f, _JaxPlatformPinner) for f in sys.meta_path):
+        return
+    sys.meta_path.insert(0, _JaxPlatformPinner(platform))
+
+
+def enable_host_platform() -> None:
+    """Undo the CPU pin for a worker that was granted TPU chips: restore
+    the host's platform selection and drop any CPU-only backends already
+    built, so the next jax call sees the accelerator."""
+    host = os.environ.get(HOST_ENV)
+    if host is None:
+        host = os.environ.get("JAX_PLATFORMS", "")
+    for finder in list(sys.meta_path):
+        if isinstance(finder, _JaxPlatformPinner):
+            sys.meta_path.remove(finder)
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", host or None)
+    except Exception:
+        return
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if _xb.backends_are_initialized():
+            _xb._clear_backends()
+    except Exception:
+        pass

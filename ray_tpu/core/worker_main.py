@@ -27,6 +27,20 @@ def main() -> None:
         level=logging.INFO,
         format=f"[worker {args.worker_id[:8]}] %(message)s")
 
+    # SIGUSR1 dumps all thread stacks to stderr (the worker log file):
+    # the debugging affordance for "worker stuck in what?" (reference:
+    # ray stack / py-spy integration).
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True, chain=False)
+
+    # Task workers must not initialize the host's TPU runtime unless their
+    # lease grants chips (site PJRT plugins ignore JAX_PLATFORMS, so this
+    # is a config-level pin applied lazily at jax import).
+    from ray_tpu.core.jax_platform import pin_worker_platform
+
+    pin_worker_platform()
+
     from ray_tpu.core.cluster_runtime import ClusterRuntime
     from ray_tpu.core.worker import set_runtime
 

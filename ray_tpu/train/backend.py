@@ -56,6 +56,12 @@ def _setup_jax_distributed(coordinator: str, world_size: int, rank: int,
         # process_count() inside jax array APIs, so pin it to cpu.
         jax.config.update("jax_platforms", "cpu")
         platform = "cpu"
+    else:
+        # A training worker targeting real chips must first undo the
+        # worker-default CPU pin (jax_platform.pin_worker_platform).
+        from ray_tpu.core.jax_platform import enable_host_platform
+
+        enable_host_platform()
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator,
