@@ -1,0 +1,18 @@
+"""ray_tpu.rllib — RL on the actor runtime, framework=jax only.
+
+Reference equivalent: `rllib/` new API stack (RLModule / Learner /
+LearnerGroup / EnvRunner / Algorithm); the old RolloutWorker/Policy stack
+and the torch/tf paths are intentionally not reproduced (SURVEY §7.9).
+"""
+
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.core.learner import PPOLearner
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
+from ray_tpu.rllib.env.env_runner import (SingleAgentEnvRunner,
+                                          compute_gae)
+
+__all__ = [
+    "PPO", "PPOConfig", "PPOLearner", "LearnerGroup",
+    "DiscreteMLPModule", "SingleAgentEnvRunner", "compute_gae",
+]

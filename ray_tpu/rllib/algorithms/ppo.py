@@ -1,0 +1,163 @@
+"""PPO (framework=jax): the new-stack algorithm loop.
+
+Reference equivalent: `rllib/algorithms/ppo/ppo.py:423` training_step —
+parallel EnvRunner sampling -> GAE -> LearnerGroup minibatch SGD ->
+weight sync (SURVEY §3.6). Env runners are CPU actors; the learner group
+is local or an SPMD gang on the Train backend.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+
+@dataclass
+class PPOConfig:
+    """Reference: algorithm_config.py + PPOConfig — the subset that
+    matters for the jax stack."""
+
+    env: str = "CartPole-v1"
+    env_creator: Optional[Callable[[], Any]] = None
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_fragment_length: int = 64
+    num_learners: int = 0          # 0 = local learner in the driver
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lam: float = 0.95
+    clip_param: float = 0.2
+    vf_coeff: float = 0.5
+    vf_clip: float = 10.0
+    entropy_coeff: float = 0.0
+    num_epochs: int = 8
+    minibatch_size: int = 128
+    hiddens: tuple = (64, 64)
+    seed: int = 0
+    platform: Optional[str] = None  # learner platform ("cpu" in tests)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def learner_config(self) -> Dict[str, Any]:
+        return {"lr": self.lr, "clip_param": self.clip_param,
+                "vf_coeff": self.vf_coeff, "vf_clip": self.vf_clip,
+                "entropy_coeff": self.entropy_coeff,
+                "num_epochs": self.num_epochs,
+                "minibatch_size": self.minibatch_size,
+                "seed": self.seed, "platform": self.platform}
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+def _default_env_creator(env_name: str):
+    def create():
+        import gymnasium as gym
+
+        return gym.make(env_name)
+
+    return create
+
+
+def _probe_spaces(env_creator) -> tuple:
+    env = env_creator()
+    obs_dim = int(np.prod(env.observation_space.shape))
+    num_actions = int(env.action_space.n)
+    env.close()
+    return obs_dim, num_actions
+
+
+class PPO:
+    """Reference: Algorithm (a Tune Trainable): `.train()` runs one
+    iteration and returns metrics."""
+
+    def __init__(self, config: PPOConfig):
+        import ray_tpu
+        from ray_tpu.rllib.core.learner_group import LearnerGroup
+        from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
+        from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+
+        self.config = config
+        env_creator = config.env_creator or _default_env_creator(config.env)
+        obs_dim, num_actions = _probe_spaces(env_creator)
+        hiddens = tuple(config.hiddens)
+
+        def module_factory(obs_dim=obs_dim, num_actions=num_actions,
+                           hiddens=hiddens):
+            return DiscreteMLPModule(obs_dim=obs_dim,
+                                     num_actions=num_actions,
+                                     hiddens=hiddens)
+
+        self.learner_group = LearnerGroup(
+            module_factory, config.learner_config(),
+            num_learners=config.num_learners)
+
+        runner_cls = ray_tpu.remote(num_cpus=1, max_concurrency=2)(
+            SingleAgentEnvRunner)
+        runner_conf = {"num_envs_per_runner": config.num_envs_per_runner}
+        self._runners = [
+            runner_cls.remote(env_creator, module_factory, runner_conf,
+                              seed=config.seed + 1000 * i)
+            for i in range(config.num_env_runners)]
+        self._sync_weights()
+        self.iteration = 0
+        self._total_steps = 0
+
+    # ------------------------------------------------------------------
+    def _sync_weights(self) -> None:
+        import ray_tpu
+
+        weights = self.learner_group.get_weights()
+        ray_tpu.get([r.set_weights.remote(weights)
+                     for r in self._runners], timeout=120)
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: sample -> GAE -> update -> sync."""
+        import ray_tpu
+        from ray_tpu.rllib.env.env_runner import (compute_gae,
+                                                  concat_batches)
+
+        t0 = time.monotonic()
+        cfg = self.config
+        rollouts = ray_tpu.get(
+            [r.sample.remote(cfg.rollout_fragment_length)
+             for r in self._runners], timeout=600)
+        batch = concat_batches(
+            [compute_gae(r, cfg.gamma, cfg.lam) for r in rollouts])
+        sample_time = time.monotonic() - t0
+
+        t1 = time.monotonic()
+        stats = self.learner_group.update(batch)
+        self._sync_weights()
+        learn_time = time.monotonic() - t1
+
+        self.iteration += 1
+        self._total_steps += len(batch["obs"])
+        episode_returns = np.concatenate(
+            [r["episode_returns"] for r in rollouts]) \
+            if any(len(r["episode_returns"]) for r in rollouts) \
+            else np.array([0.0])
+        wall = time.monotonic() - t0
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": float(episode_returns.mean()),
+            "episode_return_max": float(episode_returns.max()),
+            "num_env_steps_sampled_lifetime": self._total_steps,
+            "env_steps_per_sec": len(batch["obs"]) / max(wall, 1e-9),
+            "time_sample_s": sample_time,
+            "time_learn_s": learn_time,
+            **{f"learner/{k}": v for k, v in stats.items()},
+        }
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self._runners = []
+        self.learner_group.shutdown()

@@ -1,0 +1,142 @@
+"""PPOLearner: clipped-surrogate SGD on a jitted train step.
+
+Reference equivalent: `rllib/core/learner/learner.py:229` (update :1227)
++ `algorithms/ppo/torch/ppo_torch_learner.py` loss. TPU-first: one jitted
+step (loss + grad + adam) over minibatches; under a multi-learner group
+the batch axis is sharded over a `dp` mesh and XLA inserts the gradient
+psum (GSPMD), replacing the reference's DDP wrapper.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.core.rl_module import (DiscreteMLPModule,
+                                          categorical_entropy,
+                                          categorical_logp)
+
+
+def ppo_loss(module, params, batch, *, clip_param: float,
+             vf_coeff: float, entropy_coeff: float, vf_clip: float):
+    logits, value = module.apply(params, batch["obs"])
+    logp = categorical_logp(logits, batch["actions"])
+    ratio = jnp.exp(logp - batch["logp_old"])
+    adv = batch["advantages"]
+    surr = jnp.minimum(
+        ratio * adv,
+        jnp.clip(ratio, 1.0 - clip_param, 1.0 + clip_param) * adv)
+    policy_loss = -jnp.mean(surr)
+    # Reference vf-clip semantics (ppo_torch_learner.py): cap the squared
+    # error at vf_clip — bounds the value loss without zeroing gradients
+    # for every in-range sample.
+    vf_loss = jnp.mean(jnp.minimum(
+        (value - batch["value_targets"]) ** 2, vf_clip))
+    entropy = jnp.mean(categorical_entropy(logits))
+    total = policy_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+    stats = {"policy_loss": policy_loss, "vf_loss": vf_loss,
+             "entropy": entropy, "total_loss": total,
+             "mean_kl": jnp.mean(batch["logp_old"] - logp)}
+    return total, stats
+
+
+class PPOLearner:
+    def __init__(self, module: DiscreteMLPModule, config: Dict[str, Any],
+                 mesh: Optional[Any] = None):
+        self.module = module
+        self.config = config
+        self.optimizer = optax.adam(config.get("lr", 3e-4))
+        self.params = module.init(
+            jax.random.PRNGKey(config.get("seed", 0)))
+        self.opt_state = self.optimizer.init(self.params)
+        self._mesh = mesh  # multi-learner: dp mesh over all processes
+        self._step = self._build_step()
+
+    def _build_step(self):
+        loss_fn = partial(
+            ppo_loss, self.module,
+            clip_param=self.config.get("clip_param", 0.2),
+            vf_coeff=self.config.get("vf_coeff", 0.5),
+            entropy_coeff=self.config.get("entropy_coeff", 0.0),
+            vf_clip=self.config.get("vf_clip", 10.0))
+
+        def step(params, opt_state, batch):
+            (_, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, stats
+
+        if self._mesh is None:
+            return jax.jit(step)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        replicated = NamedSharding(self._mesh, P())
+        sharded = NamedSharding(self._mesh, P("dp"))
+        return jax.jit(
+            step,
+            in_shardings=(replicated, replicated, sharded),
+            out_shardings=(replicated, replicated, replicated))
+
+    def _device_batch(self, batch: Dict[str, np.ndarray]):
+        if self._mesh is None:
+            return {k: jnp.asarray(v) for k, v in batch.items()}
+        # Local shard -> global dp-sharded arrays: every learner holds a
+        # disjoint slice of the global batch axis (SPMD lockstep entry).
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(self._mesh, P("dp"))
+        return {k: jax.make_array_from_process_local_data(sharding, v)
+                for k, v in batch.items()}
+
+    def _replicate(self, tree):
+        if self._mesh is None:
+            return tree
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        replicated = NamedSharding(self._mesh, P())
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(
+                replicated, np.asarray(x)), tree)
+
+    def build_distributed(self) -> None:
+        """Re-place params/opt-state on the global mesh (post
+        jax.distributed init, when running inside a LearnerGroup)."""
+        self.params = self._replicate(
+            jax.tree.map(np.asarray, self.params))
+        self.opt_state = self._replicate(
+            jax.tree.map(np.asarray, self.opt_state))
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """Minibatch SGD over the (local shard of the) train batch."""
+        n = len(batch["obs"])
+        minibatch = self.config.get("minibatch_size", n) or n
+        epochs = self.config.get("num_epochs", 1)
+        # Advantage normalization over the local shard.
+        adv = batch["advantages"]
+        batch = dict(batch,
+                     advantages=(adv - adv.mean()) / (adv.std() + 1e-8))
+        rng = np.random.default_rng(self.config.get("seed", 0))
+        stats = {}
+        for _ in range(epochs):
+            perm = rng.permutation(n)
+            for lo in range(0, n, minibatch):
+                idx = perm[lo:lo + minibatch]
+                mb = self._device_batch(
+                    {k: v[idx] for k, v in batch.items()})
+                self.params, self.opt_state, stats = self._step(
+                    self.params, self.opt_state, mb)
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights: Dict[str, np.ndarray]) -> None:
+        self.params = self._replicate(weights) if self._mesh is not None \
+            else jax.tree.map(jnp.asarray, weights)

@@ -1,0 +1,116 @@
+"""LearnerGroup: local or actor-hosted learners.
+
+Reference equivalent: `rllib/core/learner/learner_group.py:61,102-117` —
+which launches learner actors with ray.train's BackendExecutor; mirrored
+here: remote learners are a `WorkerGroup` bootstrapped by `_JaxBackend`
+(jax.distributed over the gang), so the jitted update step is one SPMD
+program with the batch sharded over a `dp` mesh and gradient psum inserted
+by XLA (the DDP-wrapper seam, TPU-style).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+def _make_learner(module_factory, config, distributed: bool):
+    import jax
+
+    from ray_tpu.rllib.core.learner import PPOLearner
+
+    if config.get("platform"):
+        try:
+            jax.config.update("jax_platforms", config["platform"])
+        except Exception:
+            pass  # backends already initialized — keep what we have
+
+    mesh = None
+    if distributed:
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices("cpu")
+                             if jax.default_backend() == "cpu"
+                             else jax.devices()), ("dp",))
+    learner = PPOLearner(module_factory(), config, mesh=mesh)
+    if distributed:
+        learner.build_distributed()
+    return learner
+
+
+class LearnerGroup:
+    def __init__(self, module_factory: Callable, config: Dict[str, Any],
+                 num_learners: int = 0):
+        self.num_learners = num_learners
+        self._local = None
+        self._executor = None
+        if num_learners == 0:
+            self._local = _make_learner(module_factory, config,
+                                        distributed=False)
+            return
+        import ray_tpu
+        from ray_tpu.air.config import ScalingConfig
+        from ray_tpu.train._internal.backend_executor import BackendExecutor
+        from ray_tpu.train.backend import JaxConfig
+
+        self._executor = BackendExecutor(
+            JaxConfig(platform=config.get("platform")),
+            ScalingConfig(num_workers=num_learners))
+        # Reuse the Train gang bring-up: PG gang reservation +
+        # jax.distributed bootstrap (reference: learner_group.py:102-117).
+        self._executor.start()
+        # Learners live IN the gang's train-worker actors (execute()
+        # hooks), exactly like the reference rides BackendExecutor.
+        self._workers = self._executor.worker_group.workers
+        ray_tpu.get([w.execute.remote(_install_learner, module_factory,
+                                      config) for w in self._workers],
+                    timeout=300)
+
+    # -- API (reference: learner_group.update / get_weights) ------------
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        if self._local is not None:
+            return self._local.update(batch)
+        import ray_tpu
+
+        k = len(self._workers)
+        # Equal shards only: one SPMD step needs identical local shapes on
+        # every learner (XLA psum lockstep) — drop the remainder.
+        n = (len(batch["obs"]) // k) * k
+        shards = [{key: v[i * n // k:(i + 1) * n // k]
+                   for key, v in batch.items()} for i in range(k)]
+        # Lockstep: every learner enters the same jitted SPMD step.
+        stats = ray_tpu.get(
+            [w.execute.remote(_update_learner, shard)
+             for w, shard in zip(self._workers, shards)], timeout=600)
+        return stats[0]
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        if self._local is not None:
+            return self._local.get_weights()
+        import ray_tpu
+
+        return ray_tpu.get(
+            self._workers[0].execute.remote(_learner_weights), timeout=120)
+
+    def shutdown(self) -> None:
+        if self._executor is not None:
+            self._executor.shutdown()
+
+
+# Worker-process globals (one learner per training worker process).
+_LEARNER = None
+
+
+def _install_learner(module_factory, config) -> bool:
+    global _LEARNER
+    _LEARNER = _make_learner(module_factory, config, distributed=True)
+    return True
+
+
+def _update_learner(shard) -> Dict[str, float]:
+    return _LEARNER.update(shard)
+
+
+def _learner_weights():
+    return _LEARNER.get_weights()

@@ -1,0 +1,89 @@
+"""RLModule: the neural-net policy abstraction (framework=jax).
+
+Reference equivalent: `rllib/core/rl_module/rl_module.py` — here natively
+functional: a module is (init, apply) over a jax pytree of params, no
+framework wrapper classes. The default discrete module is a shared-trunk
+MLP with policy-logit and value heads (the reference's fcnet Catalog
+default for CartPole-class envs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class DiscreteMLPModule:
+    """obs -> {logits, vf}.
+
+    Separate policy and value MLPs by default — the reference's fcnet
+    Catalog default (`vf_share_layers=False`, models/catalog.py): a shared
+    trunk lets large early value-errors push gradients through the policy
+    body and stall learning on dense-reward envs like CartPole."""
+
+    obs_dim: int
+    num_actions: int
+    hiddens: Sequence[int] = field(default_factory=lambda: (64, 64))
+    vf_share_layers: bool = False
+
+    def _init_mlp(self, rng, prefix, out_dim, out_scale, params):
+        sizes = [self.obs_dim, *self.hiddens]
+        keys = jax.random.split(rng, len(sizes))
+        for i in range(len(sizes) - 1):
+            params[f"{prefix}w{i}"] = (jax.random.normal(
+                keys[i], (sizes[i], sizes[i + 1]), jnp.float32)
+                * jnp.sqrt(2.0 / sizes[i]))
+            params[f"{prefix}b{i}"] = jnp.zeros((sizes[i + 1],),
+                                                jnp.float32)
+        trunk = sizes[-1]
+        params[f"{prefix}w_out"] = (jax.random.normal(
+            keys[-1], (trunk, out_dim), jnp.float32) * out_scale)
+        params[f"{prefix}b_out"] = jnp.zeros((out_dim,), jnp.float32)
+
+    def _apply_mlp(self, params, prefix, obs):
+        x = obs
+        for i in range(len(self.hiddens)):
+            x = jnp.tanh(x @ params[f"{prefix}w{i}"]
+                         + params[f"{prefix}b{i}"])
+        return x @ params[f"{prefix}w_out"] + params[f"{prefix}b_out"]
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        params: Dict[str, Any] = {}
+        k_pi, k_vf = jax.random.split(rng)
+        # Small-init policy head: near-uniform initial policy.
+        self._init_mlp(k_pi, "pi_", self.num_actions, 0.01, params)
+        if not self.vf_share_layers:
+            self._init_mlp(k_vf, "vf_", 1, 1.0, params)
+        else:
+            trunk = self.hiddens[-1] if self.hiddens else self.obs_dim
+            params["vf_w_out"] = (jax.random.normal(
+                k_vf, (trunk, 1), jnp.float32) * jnp.sqrt(1.0 / trunk))
+            params["vf_b_out"] = jnp.zeros((1,), jnp.float32)
+        return params
+
+    def apply(self, params: Dict[str, Any], obs: jax.Array
+              ) -> Tuple[jax.Array, jax.Array]:
+        """Returns (logits [B, A], value [B])."""
+        x = obs
+        for i in range(len(self.hiddens)):
+            x = jnp.tanh(x @ params[f"pi_w{i}"] + params[f"pi_b{i}"])
+        logits = x @ params["pi_w_out"] + params["pi_b_out"]
+        if self.vf_share_layers:
+            value = (x @ params["vf_w_out"] + params["vf_b_out"])[..., 0]
+        else:
+            value = self._apply_mlp(params, "vf_", obs)[..., 0]
+        return logits, value
+
+
+def categorical_logp(logits: jax.Array, actions: jax.Array) -> jax.Array:
+    logp_all = jax.nn.log_softmax(logits)
+    return jnp.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
+
+
+def categorical_entropy(logits: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)

@@ -1,0 +1,154 @@
+"""SingleAgentEnvRunner: vectorized rollout collection.
+
+Reference equivalent: `rllib/env/single_agent_env_runner.py:108` — an actor
+stepping N gymnasium envs with the current policy, returning fixed-length
+fragments with per-step values/logps (what PPO's GAE needs) plus completed
+episode returns for metrics.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+
+class SingleAgentEnvRunner:
+    def __init__(self, env_creator: Callable[[], Any], module_factory,
+                 config: Dict[str, Any], seed: int = 0):
+        import jax
+
+        # Rollout inference is CPU work (reference: env runners are CPU
+        # actors); never contend for the host's TPU unless asked to.
+        platform = config.get("platform", "cpu")
+        if platform:
+            try:
+                jax.config.update("jax_platforms", platform)
+            except Exception:
+                pass
+
+        self.envs = [env_creator()
+                     for _ in range(config.get("num_envs_per_runner", 1))]
+        self.module = module_factory()
+        self.params = None
+        self.rng = np.random.default_rng(seed)
+        self._apply = jax.jit(self.module.apply)
+        self.obs = np.stack([env.reset(seed=seed + i)[0]
+                             for i, env in enumerate(self.envs)])
+        self._episode_return = np.zeros(len(self.envs))
+        self._completed: deque = deque(maxlen=50)
+
+    def set_weights(self, weights) -> bool:
+        import jax.numpy as jnp
+
+        self.params = {k: jnp.asarray(v) for k, v in weights.items()}
+        return True
+
+    def sample(self, fragment_length: int) -> Dict[str, np.ndarray]:
+        """Collect `fragment_length` steps from every env (time-major
+        rollout flattened env-by-env, with GAE inputs)."""
+        n_envs = len(self.envs)
+        T = fragment_length
+        obs_buf = np.zeros((T, n_envs) + self.obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, n_envs), np.int32)
+        rew_buf = np.zeros((T, n_envs), np.float32)
+        done_buf = np.zeros((T, n_envs), np.float32)
+        logp_buf = np.zeros((T, n_envs), np.float32)
+        val_buf = np.zeros((T, n_envs), np.float32)
+        # Time-limit truncations are NOT terminations: GAE must bootstrap
+        # V(final_obs) there or good long-episode policies hit a return
+        # ceiling (reference: postprocessing uses the final obs's vf pred
+        # on truncated episodes).
+        trunc_events: list = []  # (t, env_idx, final_obs)
+
+        for t in range(T):
+            logits, values = self._apply(self.params,
+                                         self.obs.astype(np.float32))
+            logits = np.asarray(logits)
+            probs = _softmax(logits)
+            actions = np.array([self.rng.choice(len(p), p=p)
+                                for p in probs])
+            logp = np.log(probs[np.arange(n_envs), actions] + 1e-12)
+            obs_buf[t] = self.obs
+            act_buf[t] = actions
+            logp_buf[t] = logp
+            val_buf[t] = np.asarray(values)
+            next_obs = []
+            for i, env in enumerate(self.envs):
+                o, r, term, trunc, _ = env.step(int(actions[i]))
+                rew_buf[t, i] = r
+                self._episode_return[i] += r
+                done = term or trunc
+                done_buf[t, i] = float(done)
+                if done:
+                    if trunc and not term:
+                        trunc_events.append((t, i, np.asarray(o)))
+                    self._completed.append(self._episode_return[i])
+                    self._episode_return[i] = 0.0
+                    o, _ = env.reset()
+                next_obs.append(o)
+            self.obs = np.stack(next_obs)
+
+        # Bootstrap value for the state after the fragment.
+        _, last_values = self._apply(self.params,
+                                     self.obs.astype(np.float32))
+        trunc_values = np.zeros((T, n_envs), np.float32)
+        if trunc_events:
+            finals = np.stack([o for _, _, o in trunc_events]
+                              ).astype(np.float32)
+            _, v_final = self._apply(self.params, finals)
+            v_final = np.asarray(v_final)
+            for k, (t, i, _) in enumerate(trunc_events):
+                trunc_values[t, i] = v_final[k]
+        return {
+            "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+            "dones": done_buf, "logp_old": logp_buf, "values": val_buf,
+            "last_values": np.asarray(last_values),
+            "trunc_values": trunc_values,
+            "episode_returns": np.array(list(self._completed)),
+        }
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    z = x - x.max(axis=-1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def compute_gae(rollout: Dict[str, np.ndarray], gamma: float,
+                lam: float) -> Dict[str, np.ndarray]:
+    """Generalized advantage estimation over a time-major rollout; returns
+    the flat train batch (reference: postprocessing/advantages)."""
+    rewards, values, dones = (rollout["rewards"], rollout["values"],
+                              rollout["dones"])
+    # Timeout bootstrap: a truncated step's reward absorbs the discounted
+    # value of the state the time limit cut off.
+    trunc_values = rollout.get("trunc_values")
+    if trunc_values is not None:
+        rewards = rewards + gamma * trunc_values
+    T, n_envs = rewards.shape
+    adv = np.zeros_like(rewards)
+    last_adv = np.zeros(n_envs, np.float32)
+    next_value = rollout["last_values"]
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_adv = delta + gamma * lam * nonterminal * last_adv
+        adv[t] = last_adv
+        next_value = values[t]
+    targets = adv + values
+    flat = lambda a: a.reshape((T * n_envs,) + a.shape[2:])  # noqa: E731
+    return {
+        "obs": flat(rollout["obs"]),
+        "actions": flat(rollout["actions"]),
+        "logp_old": flat(rollout["logp_old"]),
+        "advantages": flat(adv).astype(np.float32),
+        "value_targets": flat(targets).astype(np.float32),
+    }
+
+
+def concat_batches(batches: List[Dict[str, np.ndarray]]
+                   ) -> Dict[str, np.ndarray]:
+    return {k: np.concatenate([b[k] for b in batches])
+            for k in batches[0]}

@@ -1,0 +1,219 @@
+"""RLlib new-stack PPO (framework=jax) on the actor runtime.
+
+Reference coverage class: `rllib/algorithms/ppo/tests/test_ppo.py` +
+`rllib/core/learner/tests/test_learner_group.py` — BASELINE north-star #1
+(PPO CartPole learns). The quick tests assert the machinery (loss wiring,
+GAE math, weight sync, multi-learner SPMD update); the slow test drives
+CartPole-v1 to reward >= 450.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=6, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_gae_math():
+    """GAE against a hand-rolled single-env reference."""
+    from ray_tpu.rllib.env.env_runner import compute_gae
+
+    T = 5
+    rollout = {
+        "rewards": np.ones((T, 1), np.float32),
+        "values": np.zeros((T, 1), np.float32),
+        "dones": np.zeros((T, 1), np.float32),
+        "obs": np.zeros((T, 1, 3), np.float32),
+        "actions": np.zeros((T, 1), np.int32),
+        "logp_old": np.zeros((T, 1), np.float32),
+        "last_values": np.zeros((1,), np.float32),
+    }
+    gamma, lam = 0.9, 0.8
+    out = compute_gae(rollout, gamma, lam)
+    # delta_t = 1 for all t (values are 0), adv_t = sum_k (gamma*lam)^k
+    expected = np.zeros(T)
+    acc = 0.0
+    for t in range(T - 1, -1, -1):
+        acc = 1.0 + gamma * lam * acc
+        expected[t] = acc
+    np.testing.assert_allclose(out["advantages"], expected, rtol=1e-5)
+    # Episode boundary cuts the accumulation.
+    rollout["dones"][2, 0] = 1.0
+    out2 = compute_gae(rollout, gamma, lam)
+    assert out2["advantages"][2] == pytest.approx(1.0)
+
+
+def test_ppo_loss_clip_behavior():
+    """Clipped surrogate: moving logp above 1+eps on a positive-advantage
+    batch stops improving the objective."""
+    import jax
+
+    from ray_tpu.rllib.core.learner import ppo_loss
+    from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
+
+    module = DiscreteMLPModule(obs_dim=4, num_actions=2, hiddens=(8,))
+    params = module.init(jax.random.PRNGKey(0))
+    batch = {
+        "obs": np.zeros((6, 4), np.float32),
+        "actions": np.zeros((6,), np.int32),
+        "logp_old": np.full((6,), -10.0, np.float32),  # ratio >> 1+eps
+        "advantages": np.ones((6,), np.float32),
+        "value_targets": np.zeros((6,), np.float32),
+    }
+    loss, stats = ppo_loss(module, params, batch, clip_param=0.2,
+                           vf_coeff=0.0, entropy_coeff=0.0, vf_clip=10.0)
+    # With ratio clipped at 1.2 and adv=1, policy loss == -1.2 exactly.
+    assert stats["policy_loss"] == pytest.approx(-1.2, abs=1e-4)
+
+
+def test_local_learner_improves_objective():
+    """A few SGD epochs on a fixed batch must push up the prob of the
+    advantaged action (sanity of grads + adam wiring, local learner)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core.learner import PPOLearner
+    from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
+
+    module = DiscreteMLPModule(obs_dim=4, num_actions=2, hiddens=(16,))
+    learner = PPOLearner(module, {"lr": 5e-3, "num_epochs": 10,
+                                  "minibatch_size": 32, "seed": 0})
+    rng = np.random.default_rng(0)
+    obs = rng.normal(size=(64, 4)).astype(np.float32)
+    # Mixed advantages (they are mean/std-normalized inside update, so an
+    # all-equal batch would normalize to zero gradient): action 0 good,
+    # action 1 bad — both halves push the policy toward action 0.
+    actions = np.tile(np.array([0, 1], np.int32), 32)
+    advantages = np.where(actions == 0, 1.0, -1.0).astype(np.float32)
+    batch = {
+        "obs": obs,
+        "actions": actions,
+        "logp_old": np.full((64,), np.log(0.5), np.float32),
+        "advantages": advantages,
+        "value_targets": np.ones((64,), np.float32),
+    }
+
+    def p_action0(params):
+        logits, _ = module.apply(params, jnp.asarray(obs))
+        return float(jnp.mean(jax.nn.softmax(logits)[:, 0]))
+
+    before = p_action0(learner.params)
+    learner.update(batch)
+    after = p_action0(learner.params)
+    assert after > before + 0.05
+
+
+def test_env_runner_fragments_and_weight_sync(ray_cluster):
+    """Remote runner returns correctly-shaped fragments and respects
+    weight sync."""
+    import ray_tpu
+    from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
+    from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+
+    def env_creator():
+        import gymnasium as gym
+
+        return gym.make("CartPole-v1")
+
+    def module_factory():
+        return DiscreteMLPModule(obs_dim=4, num_actions=2, hiddens=(8,))
+
+    runner_cls = ray_tpu.remote(num_cpus=1)(SingleAgentEnvRunner)
+    runner = runner_cls.remote(env_creator, module_factory,
+                               {"num_envs_per_runner": 2}, seed=7)
+    import jax
+
+    weights = {k: np.asarray(v) for k, v in
+               module_factory().init(jax.random.PRNGKey(0)).items()}
+    assert ray_tpu.get(runner.set_weights.remote(weights), timeout=120)
+    frag = ray_tpu.get(runner.sample.remote(16), timeout=300)
+    assert frag["obs"].shape == (16, 2, 4)
+    assert frag["actions"].shape == (16, 2)
+    assert frag["last_values"].shape == (2,)
+    ray_tpu.kill(runner)
+
+
+def test_ppo_single_iteration_end_to_end(ray_cluster):
+    """One full PPO train() iteration on the cluster: sample -> GAE ->
+    update -> sync; metrics come back sane."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = PPOConfig(num_env_runners=2, num_envs_per_runner=2,
+                     rollout_fragment_length=16, num_epochs=2,
+                     minibatch_size=32, platform="cpu").build()
+    try:
+        m = algo.train()
+        assert m["training_iteration"] == 1
+        assert m["num_env_steps_sampled_lifetime"] == 2 * 2 * 16
+        assert np.isfinite(m["learner/total_loss"])
+        m2 = algo.train()
+        assert m2["training_iteration"] == 2
+    finally:
+        algo.stop()
+
+
+def test_multi_learner_group_spmd(ray_cluster):
+    """2 remote learners (jax.distributed gang over gloo): update runs in
+    SPMD lockstep and weights stay identical across learners."""
+    import ray_tpu
+    from ray_tpu.rllib.core.learner_group import (LearnerGroup,
+                                                  _learner_weights)
+    from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
+
+    def module_factory():
+        return DiscreteMLPModule(obs_dim=4, num_actions=2, hiddens=(8,))
+
+    group = LearnerGroup(module_factory,
+                         {"lr": 1e-3, "num_epochs": 1, "seed": 0,
+                          "platform": "cpu"},
+                         num_learners=2)
+    try:
+        rng = np.random.default_rng(0)
+        batch = {
+            "obs": rng.normal(size=(32, 4)).astype(np.float32),
+            "actions": np.zeros((32,), np.int32),
+            "logp_old": np.full((32,), np.log(0.5), np.float32),
+            "advantages": np.ones((32,), np.float32),
+            "value_targets": np.ones((32,), np.float32),
+        }
+        stats = group.update(batch)
+        assert np.isfinite(stats["total_loss"])
+        all_weights = ray_tpu.get(
+            [w.execute.remote(_learner_weights)
+             for w in group._workers], timeout=120)
+        for k in all_weights[0]:
+            np.testing.assert_allclose(all_weights[0][k],
+                                       all_weights[1][k], atol=1e-6)
+    finally:
+        group.shutdown()
+
+
+@pytest.mark.slow
+def test_ppo_cartpole_learns(ray_cluster):
+    """BASELINE north-star #1: PPO reaches >= 450 mean return on
+    CartPole-v1 (reference bar: 475 over longer training; 450 here keeps
+    CI wall-clock bounded)."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = PPOConfig(num_env_runners=2, num_envs_per_runner=8,
+                     rollout_fragment_length=64, lr=1e-3, num_epochs=8,
+                     minibatch_size=256, entropy_coeff=0.0,
+                     platform="cpu").build()
+    try:
+        best = 0.0
+        for _ in range(100):
+            m = algo.train()
+            best = max(best, m["episode_return_mean"])
+            if best >= 450:
+                break
+        assert best >= 450, f"PPO failed to learn CartPole: best={best}"
+    finally:
+        algo.stop()
