@@ -80,6 +80,11 @@ class ActorHandle:
             self, method_name, opts, args, kwargs)
 
     def __getattr__(self, name: str):
+        if name == "__ray_call__":
+            # Run an arbitrary function against the live actor instance:
+            # handle.__ray_call__.remote(fn, *args) -> fn(instance, *args)
+            # (reference: actor.py __ray_call__ system method).
+            return ActorMethod(self, "__ray_call__", 1)
         if name.startswith("_"):
             raise AttributeError(name)
         meta = self._method_meta

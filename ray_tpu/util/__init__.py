@@ -4,6 +4,8 @@ Reference equivalent: `python/ray/util/` (placement groups, collective,
 actor pools, state API).
 """
 
+from ray_tpu.util import collective  # noqa: F401
+from ray_tpu.util.device_arrays import get_to_device, to_jax  # noqa: F401
 from ray_tpu.util.placement_group import (  # noqa: F401
     PlacementGroup, get_current_placement_group, placement_group,
     placement_group_table, remove_placement_group,
@@ -16,4 +18,7 @@ __all__ = [
     "placement_group_table",
     "get_current_placement_group",
     "tpu_slice_placement_group",
+    "collective",
+    "to_jax",
+    "get_to_device",
 ]

@@ -1,0 +1,392 @@
+"""Eager (out-of-graph) collective communication between actors/tasks.
+
+Reference equivalent: `python/ray/util/collective/collective.py` (API
+:40,120,258) — `init_collective_group` / `allreduce` / `broadcast` / ...
+executed eagerly from Python, outside any compiled graph. Two backends:
+
+- ``gloo``: CPU tensors over TCP — rendezvous through the GCS KV (the
+  reference rendezvouses through a named store actor), then a
+  ProcessGroupGloo ring. This is the control-plane backend: weight
+  broadcast to rollout workers, metric reductions, barriers.
+- ``ici``: device arrays reduced by XLA collectives over the local device
+  mesh (`psum` et al. ride ICI on a real slice). Eager semantics on the
+  host side, compiled collective on device — every group member must call
+  the op in lockstep, exactly like the reference's NCCL backend.
+
+In-graph collectives for SPMD training live in `ray_tpu.parallel`; this
+module is for code that needs a collective NOW, between independently
+running processes.
+"""
+
+from __future__ import annotations
+
+import datetime
+import pickle
+import socket
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "init_collective_group", "destroy_collective_group",
+    "create_collective_group", "get_rank", "get_collective_group_size",
+    "allreduce", "allgather", "reducescatter", "broadcast", "reduce",
+    "barrier", "send", "recv", "ReduceOp",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+def _kv():
+    from ray_tpu.core.worker import current_runtime
+
+    return current_runtime()
+
+
+def _kv_key(group_name: str) -> bytes:
+    return f"collective:{group_name}".encode()
+
+
+@dataclass
+class _Group:
+    name: str
+    world_size: int
+    rank: int
+    backend: str
+    pg: Any = None          # gloo process group
+    store: Any = None       # keepalive: TCPStore master must outlive pg
+    mesh: Any = None        # ici: jax mesh over local devices
+    _jitted: Dict[str, Any] = None
+
+
+_GROUPS: Dict[str, _Group] = {}
+
+
+# ---------------------------------------------------------------------------
+# group lifecycle
+# ---------------------------------------------------------------------------
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "gloo",
+                          group_name: str = "default",
+                          timeout_s: float = 60.0) -> None:
+    """Collectively create a named group: every member calls this with its
+    rank (reference: collective.py:120 `init_collective_group`)."""
+    if group_name in _GROUPS:
+        raise RuntimeError(f"collective group {group_name!r} already "
+                           "initialized in this process")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world size "
+                         f"{world_size}")
+    if backend == "gloo":
+        group = _init_gloo(world_size, rank, group_name, timeout_s)
+    elif backend == "ici":
+        group = _init_ici(world_size, rank, group_name)
+    else:
+        raise ValueError(f"unknown collective backend {backend!r} "
+                         "(expected 'gloo' or 'ici')")
+    _GROUPS[group_name] = group
+
+
+def _init_gloo(world_size: int, rank: int, group_name: str,
+               timeout_s: float) -> _Group:
+    import torch.distributed as dist
+
+    rt = _kv()
+    key = _kv_key(group_name)
+    store = None
+    if rank == 0:
+        host = socket.gethostbyname(socket.gethostname())
+        s = socket.socket()
+        s.bind((host, 0))
+        port = s.getsockname()[1]
+        s.close()
+        # wait_for_workers=False: the master must NOT block before the
+        # rendezvous address is published, or no client can ever join.
+        store = dist.TCPStore(host, port, world_size, True,
+                              timeout=datetime.timedelta(seconds=timeout_s),
+                              wait_for_workers=False)
+        rt.kv_put(key, pickle.dumps((host, port, world_size)))
+    else:
+        deadline = time.monotonic() + timeout_s
+        blob = None
+        while time.monotonic() < deadline:
+            blob = rt.kv_get(key)
+            if blob is not None:
+                break
+            time.sleep(0.05)
+        if blob is None:
+            raise TimeoutError(
+                f"collective group {group_name!r}: rank 0 never published "
+                "a rendezvous address")
+        host, port, declared = pickle.loads(blob)
+        if declared != world_size:
+            raise ValueError(
+                f"group {group_name!r} declared world_size={declared}, "
+                f"this rank expected {world_size}")
+        store = dist.TCPStore(host, port, world_size, False,
+                              timeout=datetime.timedelta(seconds=timeout_s))
+    pg = dist.ProcessGroupGloo(
+        dist.PrefixStore(group_name, store), rank, world_size,
+        datetime.timedelta(seconds=timeout_s))
+    return _Group(group_name, world_size, rank, "gloo", pg=pg, store=store)
+
+
+def _init_ici(world_size: int, rank: int, group_name: str) -> _Group:
+    """XLA-collective group over the ICI fabric: every member process
+    contributes its local array and the reduction runs as one compiled
+    XLA op over all devices. Requires `jax.distributed` to be initialized
+    when world_size > 1 (e.g. inside a Train/Learner gang) — the mesh
+    spans all processes' devices with a leading `proc` axis."""
+    import jax
+    from jax.sharding import Mesh
+
+    if world_size > 1:
+        if jax.process_count() < world_size:
+            raise RuntimeError(
+                f"ici group of {world_size} needs jax.distributed across "
+                f"{world_size} processes (have {jax.process_count()}); "
+                "use the gloo backend for plain CPU actors")
+        if rank != jax.process_index():
+            raise ValueError(
+                f"ici rank {rank} must equal jax.process_index() "
+                f"{jax.process_index()} — the mesh order is fixed by the "
+                "distributed runtime")
+    devices = np.array(jax.devices()).reshape(world_size, -1)
+    mesh = Mesh(devices, ("proc", "local"))
+    return _Group(group_name, world_size, rank, "ici", mesh=mesh,
+                  _jitted={})
+
+
+def create_collective_group(actors: List[Any], world_size: int,
+                            ranks: List[int], backend: str = "gloo",
+                            group_name: str = "default") -> None:
+    """Driver-side declaration: pushes `init_collective_group` into every
+    actor (reference: collective.py:40 `create_collective_group` /
+    declare_collective_group)."""
+    import ray_tpu
+
+    if len(actors) != len(ranks):
+        raise ValueError("actors and ranks must align")
+    refs = [a.__ray_call__.remote(_remote_init, world_size, r, backend,
+                                  group_name)
+            for a, r in zip(actors, ranks)]
+    ray_tpu.get(refs, timeout=120)
+
+
+def _remote_init(self_obj, world_size, rank, backend, group_name):
+    init_collective_group(world_size, rank, backend, group_name)
+    return True
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    group = _GROUPS.pop(group_name, None)
+    if group is None:
+        return
+    if group.backend == "gloo" and group.rank == 0:
+        try:
+            _kv().kv_del(_kv_key(group_name))
+        except Exception:
+            pass
+    group.pg = None
+    group.store = None
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _require(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _require(group_name).world_size
+
+
+def _require(group_name: str) -> _Group:
+    group = _GROUPS.get(group_name)
+    if group is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized in this "
+            "process; call init_collective_group first")
+    return group
+
+
+def _require_gloo(group_name: str, op: str) -> _Group:
+    group = _require(group_name)
+    if group.backend != "gloo":
+        raise NotImplementedError(
+            f"{op} is not supported on the {group.backend!r} backend; "
+            "use gloo, or in-graph jax collectives via ray_tpu.parallel")
+    return group
+
+
+# ---------------------------------------------------------------------------
+# tensor conversion — keep the caller's array type
+# ---------------------------------------------------------------------------
+def _to_torch(array):
+    import torch
+
+    np_arr = np.ascontiguousarray(np.asarray(array))
+    return torch.from_numpy(np_arr), np_arr.dtype
+
+
+def _from_torch(tensor, like):
+    out = tensor.numpy()
+    if type(like).__module__.startswith("jax"):
+        import jax.numpy as jnp
+
+        return jnp.asarray(out)
+    return out
+
+
+def _torch_op(op: str):
+    import torch.distributed as dist
+
+    return {ReduceOp.SUM: dist.ReduceOp.SUM,
+            ReduceOp.PRODUCT: dist.ReduceOp.PRODUCT,
+            ReduceOp.MIN: dist.ReduceOp.MIN,
+            ReduceOp.MAX: dist.ReduceOp.MAX}[op]
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+def allreduce(tensor, group_name: str = "default",
+              op: str = ReduceOp.SUM):
+    """All-reduce; returns the reduced array (same array type as input).
+    Reference: collective.py:258."""
+    group = _require(group_name)
+    if group.backend == "ici":
+        return _ici_allreduce(group, tensor, op)
+    import torch.distributed as dist
+
+    t, _ = _to_torch(tensor)
+    opts = dist.AllreduceOptions()
+    opts.reduceOp = _torch_op(op)
+    group.pg.allreduce([t], opts).wait()
+    return _from_torch(t, tensor)
+
+
+def allgather(tensor, group_name: str = "default") -> List[Any]:
+    """Gathers every rank's tensor; returns a list of arrays in rank
+    order."""
+    group = _require(group_name)
+    if group.backend == "ici":
+        raise NotImplementedError(
+            "ici allgather: use in-graph jax.lax.all_gather via "
+            "ray_tpu.parallel for device arrays")
+    t, _ = _to_torch(tensor)
+    import torch
+
+    outs = [[torch.zeros_like(t) for _ in range(group.world_size)]]
+    group.pg.allgather(outs, [t]).wait()
+    return [_from_torch(o, tensor) for o in outs[0]]
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: str = ReduceOp.SUM):
+    """Reduce-scatter along axis 0: rank i receives slice i of the
+    reduction. Gloo lacks a native reducescatter; reduce+slice matches
+    the reference's pygloo fallback."""
+    group = _require(group_name)
+    reduced = allreduce(tensor, group_name, op)
+    n = group.world_size
+    size = reduced.shape[0]
+    if size % n:
+        raise ValueError(f"reducescatter: axis-0 size {size} not "
+                         f"divisible by world size {n}")
+    chunk = size // n
+    return reduced[group.rank * chunk:(group.rank + 1) * chunk]
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    group = _require(group_name)
+    if group.backend == "ici":
+        raise NotImplementedError(
+            "ici broadcast: device arrays are replicated via sharding "
+            "annotations (ray_tpu.parallel), not eager broadcast")
+    import torch.distributed as dist
+
+    t, _ = _to_torch(tensor)
+    opts = dist.BroadcastOptions()
+    opts.rootRank = src_rank
+    opts.rootTensor = 0
+    group.pg.broadcast([t], opts).wait()
+    return _from_torch(t, tensor)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: str = ReduceOp.SUM):
+    group = _require_gloo(group_name, "reduce")
+    import torch.distributed as dist
+
+    t, _ = _to_torch(tensor)
+    opts = dist.ReduceOptions()
+    opts.reduceOp = _torch_op(op)
+    opts.rootRank = dst_rank
+    group.pg.reduce([t], opts).wait()
+    return _from_torch(t, tensor)
+
+
+def barrier(group_name: str = "default") -> None:
+    group = _require(group_name)
+    if group.backend == "ici":
+        import jax
+
+        jax.effects_barrier()
+        return
+    group.pg.barrier().wait()
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    group = _require_gloo(group_name, "send")
+    t, _ = _to_torch(tensor)
+    group.pg.send([t], dst_rank, 0).wait()
+
+
+def recv(tensor, src_rank: int, group_name: str = "default"):
+    """Receives into a tensor of the given shape/dtype; returns it."""
+    group = _require_gloo(group_name, "recv")
+    t, _ = _to_torch(tensor)
+    group.pg.recv([t], src_rank, 0).wait()
+    return _from_torch(t, tensor)
+
+
+# ---------------------------------------------------------------------------
+# ici backend: XLA device collectives
+# ---------------------------------------------------------------------------
+def _ici_allreduce(group: _Group, tensor, op: str):
+    """Every member's array is placed as slice `rank` of a
+    [world, *shape] global array (sharded over the `proc` mesh axis, i.e.
+    resident on that member's devices), then one compiled reduction over
+    the proc axis runs on the ICI fabric and the replicated result comes
+    back to every member. world_size == 1 degenerates to identity —
+    allreduce over one member IS the identity."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    reducers = {ReduceOp.SUM: jnp.sum, ReduceOp.PRODUCT: jnp.prod,
+                ReduceOp.MAX: jnp.max, ReduceOp.MIN: jnp.min}
+    if op not in reducers:
+        raise NotImplementedError(f"ici allreduce op {op!r}")
+    local = np.asarray(tensor)[None, ...]     # this member's slice
+    sharded = NamedSharding(group.mesh, P("proc"))
+    replicated = NamedSharding(group.mesh, P())
+    if group.world_size == 1:
+        garr = jnp.asarray(local)
+    else:
+        garr = jax.make_array_from_process_local_data(sharded, local)
+    key = f"allreduce:{op}:{garr.shape}:{garr.dtype}"
+    if key not in group._jitted:
+        reducer = reducers[op]
+        group._jitted[key] = jax.jit(
+            lambda x: reducer(x, axis=0), out_shardings=replicated)
+    out = group._jitted[key](garr)
+    if isinstance(tensor, np.ndarray):
+        return np.asarray(out)
+    return out
