@@ -1,0 +1,305 @@
+"""ray_tpu.serve — model serving on the actor runtime.
+
+Reference equivalent: `python/ray/serve/` — `@serve.deployment` +
+`serve.run` with a controller reconciling replica actors, an HTTP ingress
+proxy, power-of-two-choices routing, queue-length autoscaling, and
+graceful rolling updates. TPU-first notes: deployments holding jitted
+models keep compiled executables warm per replica process, and
+`@serve.batch` folds concurrent single requests into one batched forward
+pass so the MXU sees large matmuls (`serve/batching.py` in the
+reference).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import time
+from dataclasses import replace
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.serve.config import (AutoscalingConfig, DeploymentConfig,
+                                  HTTPOptions)
+from ray_tpu.serve.exceptions import (DeploymentUnavailableError,
+                                      RayServeException,
+                                      ReplicaDrainingError)
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "deployment", "run", "delete", "shutdown", "status",
+    "get_app_handle", "get_deployment_handle", "batch",
+    "Deployment", "Application", "DeploymentHandle",
+    "DeploymentResponse", "AutoscalingConfig", "DeploymentConfig",
+    "HTTPOptions", "RayServeException", "ReplicaDrainingError",
+    "DeploymentUnavailableError",
+]
+
+_PROXY_NAME = "SERVE_PROXY"
+_http_port: Optional[int] = None
+
+
+class Deployment:
+    """The declarative unit: a user class + deployment config.
+    Reference: serve/deployment.py Deployment."""
+
+    def __init__(self, cls, name: str, config: DeploymentConfig):
+        self._cls = cls
+        self.name = name
+        self.config = config
+
+    def options(self, *, num_replicas: Optional[int] = None,
+                version: Optional[str] = None,
+                max_ongoing_requests: Optional[int] = None,
+                autoscaling_config: Optional[AutoscalingConfig] = None,
+                ray_actor_options: Optional[Dict[str, Any]] = None,
+                name: Optional[str] = None) -> "Deployment":
+        cfg = replace(self.config)
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if version is not None:
+            cfg.version = version
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = max_ongoing_requests
+        if autoscaling_config is not None:
+            cfg.autoscaling_config = autoscaling_config
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = ray_actor_options
+        return Deployment(self._cls, name or self.name, cfg)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+
+class Application:
+    """A bound deployment graph node (reference: serve/api.py
+    Application). MVP: a single deployment + its init args."""
+
+    def __init__(self, deployment: Deployment, args, kwargs):
+        self.deployment = deployment
+        self.init_args = args
+        self.init_kwargs = kwargs
+
+
+def deployment(cls=None, *, name: Optional[str] = None,
+               num_replicas: int = 1,
+               max_ongoing_requests: int = 16,
+               autoscaling_config: Optional[AutoscalingConfig] = None,
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               version: Optional[str] = None):
+    """`@serve.deployment` (reference: serve/api.py:deployment)."""
+
+    def wrap(c):
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling_config=autoscaling_config,
+            ray_actor_options=ray_actor_options or {},
+            version=version)
+        return Deployment(c, name or c.__name__, cfg)
+
+    return wrap(cls) if cls is not None else wrap
+
+
+# ---------------------------------------------------------------------------
+# control plane entry points
+# ---------------------------------------------------------------------------
+def _get_or_create_controller():
+    import ray_tpu
+    from ray_tpu.serve._private.controller import (CONTROLLER_NAME,
+                                                   ServeController)
+
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        pass
+    actor_cls = ray_tpu.remote(num_cpus=0, name=CONTROLLER_NAME,
+                               max_concurrency=32)(ServeController)
+    try:
+        return actor_cls.remote()
+    except Exception:
+        # Lost a creation race: someone else registered the name first.
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+
+def start(http_options: Optional[HTTPOptions] = None) -> int:
+    """Start (or find) the Serve instance: controller + HTTP proxy.
+    Returns the proxy port."""
+    global _http_port
+    import ray_tpu
+    from ray_tpu.serve._private.proxy import HTTPProxy
+
+    controller = _get_or_create_controller()
+    try:
+        proxy = ray_tpu.get_actor(_PROXY_NAME)
+    except Exception:
+        opts = http_options or HTTPOptions(port=0)
+        actor_cls = ray_tpu.remote(num_cpus=0, name=_PROXY_NAME,
+                                   max_concurrency=64)(HTTPProxy)
+        proxy = actor_cls.remote(controller, opts.host, opts.port)
+        _http_port = ray_tpu.get(proxy.start.remote(), timeout=60)
+    if _http_port is None:
+        _http_port = ray_tpu.get(proxy.ready.remote(), timeout=60)
+    return _http_port
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/",
+        wait_for_ready: bool = True,
+        _blocking_timeout_s: float = 60.0) -> DeploymentHandle:
+    """Deploy (or update) an application; returns its handle
+    (reference: serve/api.py:run)."""
+    import ray_tpu
+    from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+    start()
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    dep = app.deployment
+    ray_tpu.get(controller.deploy.remote(
+        dep.name, dep._cls, app.init_args, app.init_kwargs, dep.config,
+        route_prefix=route_prefix), timeout=60)
+    handle = DeploymentHandle(dep.name, controller)
+    if wait_for_ready:
+        _wait_ready(controller, dep.name, _blocking_timeout_s)
+    return handle
+
+
+def _wait_ready(controller, deployment_name: str,
+                timeout_s: float) -> None:
+    import ray_tpu
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        status_ = ray_tpu.get(controller.status.remote(), timeout=30)
+        info = status_.get(deployment_name)
+        if info and any(r["state"] == "RUNNING"
+                        for r in info["replicas"]):
+            return
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"deployment {deployment_name!r} has no RUNNING replica after "
+        f"{timeout_s}s")
+
+
+def status() -> Dict[str, Any]:
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.status.remote(), timeout=30)
+
+
+def get_app_handle(name: str) -> DeploymentHandle:
+    return get_deployment_handle(name)
+
+
+def get_deployment_handle(deployment_name: str) -> DeploymentHandle:
+    import ray_tpu
+    from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return DeploymentHandle(deployment_name, controller)
+
+
+def delete(deployment_name: str) -> None:
+    import ray_tpu
+    from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ray_tpu.get(controller.delete_deployment.remote(deployment_name),
+                timeout=60)
+
+
+def shutdown() -> None:
+    global _http_port
+    import ray_tpu
+    from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.shutdown.remote(), timeout=60)
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+    try:
+        proxy = ray_tpu.get_actor(_PROXY_NAME)
+        ray_tpu.kill(proxy)
+    except Exception:
+        pass
+    _http_port = None
+
+
+# ---------------------------------------------------------------------------
+# request batching (the MXU lever)
+# ---------------------------------------------------------------------------
+def batch(fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Fold concurrent single calls into one batched call (reference:
+    `python/ray/serve/batching.py` @serve.batch). The wrapped async
+    method receives a LIST of inputs and must return a list of outputs;
+    callers await single results. On a jitted model this turns N
+    replica-concurrent requests into one [N, ...] forward pass."""
+
+    def wrap(f):
+        queues: Dict[int, "_BatchQueue"] = {}
+
+        @functools.wraps(f)
+        async def wrapper(self, item):
+            loop = asyncio.get_running_loop()
+            q = queues.get(id(loop))
+            if q is None:
+                q = _BatchQueue(f, max_batch_size, batch_wait_timeout_s)
+                queues[id(loop)] = q
+            return await q.submit(self, item)
+
+        wrapper._is_serve_batch = True
+        return wrapper
+
+    return wrap(fn) if fn is not None else wrap
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 wait_timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._wait = wait_timeout_s
+        self._items: List[Any] = []
+        self._futures: List[asyncio.Future] = []
+        self._flusher: Optional[asyncio.Task] = None
+
+    async def submit(self, owner, item):
+        fut = asyncio.get_running_loop().create_future()
+        self._items.append(item)
+        self._futures.append(fut)
+        if len(self._items) >= self._max:
+            self._flush_now(owner)
+        elif self._flusher is None or self._flusher.done():
+            self._flusher = asyncio.get_running_loop().create_task(
+                self._delayed_flush(owner))
+        return await fut
+
+    async def _delayed_flush(self, owner):
+        await asyncio.sleep(self._wait)
+        self._flush_now(owner)
+
+    def _flush_now(self, owner) -> None:
+        if not self._items:
+            return
+        items, futures = self._items, self._futures
+        self._items, self._futures = [], []
+        asyncio.get_running_loop().create_task(
+            self._run_batch(owner, items, futures))
+
+    async def _run_batch(self, owner, items, futures) -> None:
+        try:
+            outs = await self._fn(owner, items)
+            if len(outs) != len(items):
+                raise RayServeException(
+                    f"@serve.batch function returned {len(outs)} results "
+                    f"for {len(items)} inputs")
+            for fut, out in zip(futures, outs):
+                if not fut.done():
+                    fut.set_result(out)
+        except BaseException as e:  # noqa: BLE001
+            for fut in futures:
+                if not fut.done():
+                    fut.set_exception(e)

@@ -1,0 +1,313 @@
+"""ServeController: the reconciliation loop.
+
+Reference equivalent: `python/ray/serve/_private/controller.py:87,347` —
+an actor holding target state (deployments, versions, replica counts) and
+converging actual state to it: starting replicas, draining and stopping
+extras, rolling version updates one replica at a time (start-new →
+drain-old), restarting dead replicas, and queue-length autoscaling
+(`autoscaling_policy.py:12`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+@dataclass
+class _ReplicaState:
+    handle: Any
+    replica_id: str
+    version: Optional[str]
+    state: str = "STARTING"        # STARTING | RUNNING | STOPPING
+    ongoing: int = 0
+    last_seen: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class _DeploymentState:
+    name: str
+    cls_factory: Any
+    init_args: tuple
+    init_kwargs: dict
+    config: Any                    # DeploymentConfig
+    target_replicas: int
+    replicas: List[_ReplicaState] = field(default_factory=list)
+    route_version: int = 0         # bumped whenever the running set changes
+    last_scale_up: float = 0.0
+    last_scale_down: float = 0.0
+    _scale_high_since: Optional[float] = None
+    _scale_low_since: Optional[float] = None
+
+
+class ServeController:
+    def __init__(self):
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._routes: Dict[str, str] = {}   # route_prefix -> deployment
+        self._shutdown = False
+        # The ctor runs off the actor event loop; the reconcile task is
+        # created lazily from the first async call, which does run on it.
+        self._loop_task = None
+
+    def _ensure_reconciler(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._reconcile_loop())
+
+    # -- API (driver / serve.run) --------------------------------------
+    async def deploy(self, name: str, cls_factory, init_args, init_kwargs,
+                     config, route_prefix: Optional[str] = None) -> bool:
+        """Create or update a deployment. A changed version triggers a
+        rolling update; a changed num_replicas scales."""
+        self._ensure_reconciler()
+        existing = self._deployments.get(name)
+        target = (config.autoscaling_config.min_replicas
+                  if config.autoscaling_config else config.num_replicas)
+        if existing is None:
+            self._deployments[name] = _DeploymentState(
+                name=name, cls_factory=cls_factory,
+                init_args=tuple(init_args), init_kwargs=dict(init_kwargs),
+                config=config, target_replicas=target)
+        else:
+            existing.cls_factory = cls_factory
+            existing.init_args = tuple(init_args)
+            existing.init_kwargs = dict(init_kwargs)
+            old_autoscaling = existing.config.autoscaling_config
+            existing.config = config
+            if config.autoscaling_config is None:
+                existing.target_replicas = config.num_replicas
+            elif old_autoscaling is None:
+                existing.target_replicas = target
+        if route_prefix is not None:
+            self._routes[route_prefix] = name
+        return True
+
+    async def delete_deployment(self, name: str) -> bool:
+        state = self._deployments.pop(name, None)
+        if state is None:
+            return False
+        self._routes = {r: d for r, d in self._routes.items() if d != name}
+        await asyncio.gather(
+            *[self._stop_replica(state, r) for r in list(state.replicas)],
+            return_exceptions=True)
+        return True
+
+    async def get_routing_table(self, name: str) -> Dict[str, Any]:
+        """Running replicas for a deployment + a version counter the
+        router uses for cache invalidation."""
+        self._ensure_reconciler()
+        state = self._deployments.get(name)
+        if state is None:
+            return {"version": -1, "replicas": []}
+        return {
+            "version": state.route_version,
+            "replicas": [(r.replica_id, r.handle) for r in state.replicas
+                         if r.state == "RUNNING"],
+        }
+
+    async def get_routes(self) -> Dict[str, str]:
+        return dict(self._routes)
+
+    async def status(self) -> Dict[str, Any]:
+        out = {}
+        for name, st in self._deployments.items():
+            out[name] = {
+                "target_replicas": st.target_replicas,
+                "replicas": [
+                    {"id": r.replica_id, "state": r.state,
+                     "version": r.version, "ongoing": r.ongoing}
+                    for r in st.replicas],
+            }
+        return out
+
+    async def shutdown(self) -> bool:
+        self._shutdown = True
+        for state in list(self._deployments.values()):
+            await self.delete_deployment(state.name)
+        return True
+
+    # -- reconciliation -------------------------------------------------
+    async def _reconcile_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                for state in list(self._deployments.values()):
+                    await self._reconcile(state)
+                    await self._autoscale(state)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+            await asyncio.sleep(0.1)
+
+    async def _reconcile(self, state: _DeploymentState) -> None:
+        version = state.config.version
+        # 1. Reap dead replicas (health probe).
+        for r in list(state.replicas):
+            if r.state != "RUNNING":
+                continue
+            if time.monotonic() - r.last_seen \
+                    < state.config.health_check_period_s:
+                continue
+            try:
+                await _aget(r.handle.check_health.remote(), timeout=5.0)
+                r.last_seen = time.monotonic()
+            except Exception:
+                # Reap AND kill: dropping it from the table without
+                # killing would leak a live actor (and its resources)
+                # serving stale traffic forever.
+                state.replicas.remove(r)
+                state.route_version += 1
+                try:
+                    import ray_tpu
+
+                    ray_tpu.kill(r.handle)
+                except Exception:
+                    pass
+        running = [r for r in state.replicas if r.state == "RUNNING"]
+        current = [r for r in running if r.version == version]
+        outdated = [r for r in running if r.version != version]
+        starting = [r for r in state.replicas if r.state == "STARTING"]
+
+        # 2. Scale up: missing replicas (count outdated ones still serving
+        # so a rolling update replaces one at a time instead of doubling).
+        deficit = state.target_replicas - (len(current) + len(starting)
+                                           + len(outdated))
+        # During a rolling update keep one extra slot so a new-version
+        # replica starts BEFORE an old one drains (no capacity dip).
+        if outdated and deficit <= 0:
+            deficit = 1 if not starting else 0
+        for _ in range(max(deficit, 0)):
+            try:
+                self._start_replica(state)
+            except Exception:
+                # Constructor failed synchronously (user __init__ error):
+                # back off one tick instead of crash-looping hot.
+                import traceback
+
+                traceback.print_exc()
+                break
+
+        # 3. Rolling replace: once a current-version replica is up, drain
+        # outdated ones.
+        surplus = (len(current) + len(outdated)) - state.target_replicas
+        if outdated and len(current) >= 1 and surplus > 0:
+            await self._stop_replica(state, outdated[0])
+
+        # 4. Scale down extras of the current version.
+        elif len(current) > state.target_replicas:
+            victim = min(current, key=lambda r: r.ongoing)
+            await self._stop_replica(state, victim)
+
+        # 5. Promote replicas that finished starting; drop ones whose
+        # actor died during __init__ (or never came up) so the deficit
+        # recomputes and a replacement starts — otherwise a ghost
+        # STARTING entry wedges the deployment at 0 RUNNING forever.
+        from ray_tpu.exceptions import RayActorError
+
+        for r in starting:
+            try:
+                await _aget(r.handle.check_health.remote(), timeout=0.5)
+            except RayActorError:
+                state.replicas.remove(r)
+                continue
+            except Exception:
+                if time.monotonic() - r.last_seen > 120.0:
+                    state.replicas.remove(r)
+                    try:
+                        import ray_tpu
+
+                        ray_tpu.kill(r.handle)
+                    except Exception:
+                        pass
+                continue
+            r.state = "RUNNING"
+            r.last_seen = time.monotonic()
+            state.route_version += 1
+
+    def _start_replica(self, state: _DeploymentState) -> None:
+        import ray_tpu
+        from ray_tpu.serve._private.replica import Replica
+
+        replica_id = f"{state.name}#{uuid.uuid4().hex[:6]}"
+        opts = dict(state.config.ray_actor_options)
+        opts.setdefault("num_cpus", 0)
+        opts.setdefault("max_concurrency",
+                        state.config.max_ongoing_requests)
+        actor_cls = ray_tpu.remote(**opts)(Replica)
+        handle = actor_cls.remote(
+            state.cls_factory, state.init_args, state.init_kwargs,
+            state.name, replica_id, state.config.version)
+        state.replicas.append(_ReplicaState(
+            handle=handle, replica_id=replica_id,
+            version=state.config.version))
+
+    async def _stop_replica(self, state: _DeploymentState,
+                            replica: _ReplicaState) -> None:
+        import ray_tpu
+
+        if replica in state.replicas:
+            replica.state = "STOPPING"
+            state.replicas.remove(replica)
+            state.route_version += 1
+        try:
+            await _aget(
+                replica.handle.prepare_for_shutdown.remote(
+                    state.config.graceful_shutdown_timeout_s),
+                timeout=state.config.graceful_shutdown_timeout_s + 5)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(replica.handle)
+        except Exception:
+            pass
+
+    # -- autoscaling ----------------------------------------------------
+    async def _autoscale(self, state: _DeploymentState) -> None:
+        cfg = state.config.autoscaling_config
+        if cfg is None:
+            return
+        running = [r for r in state.replicas if r.state == "RUNNING"]
+        if not running:
+            return
+        total = 0
+        for r in running:
+            try:
+                m = await _aget(r.handle.metrics.remote(), timeout=2.0)
+                r.ongoing = m["ongoing"]
+                total += m["ongoing"]
+            except Exception:
+                pass
+        desired = math.ceil(total / max(cfg.target_ongoing_requests, 1e-9))
+        desired = min(max(desired, cfg.min_replicas), cfg.max_replicas)
+        now = time.monotonic()
+        if desired > state.target_replicas:
+            state._scale_low_since = None
+            if state._scale_high_since is None:
+                state._scale_high_since = now
+            if now - state._scale_high_since >= cfg.upscale_delay_s:
+                state.target_replicas = desired
+                state._scale_high_since = None
+        elif desired < state.target_replicas:
+            state._scale_high_since = None
+            if state._scale_low_since is None:
+                state._scale_low_since = now
+            if now - state._scale_low_since >= cfg.downscale_delay_s:
+                state.target_replicas = desired
+                state._scale_low_since = None
+        else:
+            state._scale_high_since = None
+            state._scale_low_since = None
+
+
+async def _aget(ref, timeout: Optional[float] = None):
+    """Await an ObjectRef from inside the controller's event loop without
+    blocking it (ray_tpu.get is thread-blocking)."""
+    import ray_tpu
+
+    return await asyncio.to_thread(ray_tpu.get, ref, timeout=timeout)

@@ -1,0 +1,163 @@
+"""HTTP proxy actor: the ingress edge.
+
+Reference equivalent: `python/ray/serve/_private/proxy.py:1082` (there:
+uvicorn/ASGI). Here: an asyncio HTTP/1.1 server living on the proxy
+actor's event loop. Requests route by longest matching route prefix to a
+DeploymentHandle; responses are JSON (dict/list returns), raw bytes, or
+text. The proxy refreshes its route table from the controller
+periodically, so `serve.run` of a new app is picked up without restarts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+
+class HTTPProxy:
+    def __init__(self, controller_handle, host: str = "127.0.0.1",
+                 port: int = 8000):
+        self._controller = controller_handle
+        self.host = host
+        self.port = port
+        self._server = None
+        self._handles: Dict[str, Any] = {}
+        self._routes: Dict[str, str] = {}
+        self._route_task = None
+
+    async def start(self) -> int:
+        """Bind and serve; returns the bound port (0 → ephemeral)."""
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._route_task = asyncio.get_running_loop().create_task(
+            self._refresh_routes_loop())
+        return self.port
+
+    async def _refresh_routes_loop(self) -> None:
+        while True:
+            try:
+                self._routes = await asyncio.to_thread(
+                    self._get_routes_blocking)
+            except Exception:
+                pass
+            await asyncio.sleep(1.0)
+
+    def _get_routes_blocking(self) -> Dict[str, str]:
+        import ray_tpu
+
+        return ray_tpu.get(self._controller.get_routes.remote(),
+                           timeout=10)
+
+    def _match_route(self, path: str) -> Optional[str]:
+        best = None
+        for prefix, deployment in self._routes.items():
+            norm = prefix.rstrip("/") or "/"
+            if path == norm or path.startswith(
+                    norm + "/") or norm == "/":
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, deployment)
+        return best[1] if best else None
+
+    def _handle_for(self, deployment: str):
+        handle = self._handles.get(deployment)
+        if handle is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            handle = DeploymentHandle(deployment, self._controller)
+            self._handles[deployment] = handle
+        return handle
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                request = await self._read_request(reader)
+                if request is None:
+                    break
+                status, body, ctype = await self._dispatch(request)
+                writer.write(
+                    b"HTTP/1.1 " + status + b"\r\n"
+                    b"Content-Type: " + ctype + b"\r\n"
+                    b"Content-Length: " + str(len(body)).encode()
+                    + b"\r\n"
+                    b"Connection: keep-alive\r\n\r\n" + body)
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader) -> Optional[dict]:
+        line = await reader.readline()
+        if not line:
+            return None
+        try:
+            method, target, _ = line.decode().split(" ", 2)
+        except ValueError:
+            return None
+        headers = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        body = b""
+        length = int(headers.get("content-length", 0) or 0)
+        if length:
+            body = await reader.readexactly(length)
+        parsed = urlparse(target)
+        return {"method": method.upper(), "path": parsed.path,
+                "query": {k: v[0] for k, v in
+                          parse_qs(parsed.query).items()},
+                "headers": headers, "body": body}
+
+    async def _dispatch(self, request: dict):
+        deployment = self._match_route(request["path"])
+        if deployment is None:
+            # Route miss: the periodic refresh may simply not have seen a
+            # just-deployed app yet — force one refresh before 404ing.
+            try:
+                self._routes = await asyncio.to_thread(
+                    self._get_routes_blocking)
+            except Exception:
+                pass
+            deployment = self._match_route(request["path"])
+        if deployment is None:
+            return b"404 Not Found", b"no route", b"text/plain"
+        handle = self._handle_for(deployment)
+        try:
+            # Routing + result are blocking; keep the proxy loop free.
+            value = await asyncio.to_thread(
+                self._call_blocking, handle, request)
+        except Exception as e:  # noqa: BLE001
+            return (b"500 Internal Server Error",
+                    f"{type(e).__name__}: {e}".encode(), b"text/plain")
+        if isinstance(value, (dict, list)):
+            return (b"200 OK", json.dumps(value).encode(),
+                    b"application/json")
+        if isinstance(value, bytes):
+            return b"200 OK", value, b"application/octet-stream"
+        return b"200 OK", str(value).encode(), b"text/plain"
+
+    def _call_blocking(self, handle, request: dict):
+        body = request["body"]
+        payload: Any = request
+        ctype = request["headers"].get("content-type", "")
+        if body and "application/json" in ctype:
+            payload = json.loads(body)
+        elif not body and request["query"]:
+            payload = request["query"]
+        return handle.remote(payload).result(timeout_s=60)
+
+    async def ready(self) -> int:
+        return self.port
+
+    def check_health(self) -> bool:
+        return True

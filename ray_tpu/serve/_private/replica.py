@@ -1,0 +1,87 @@
+"""Serve replica actor: wraps one instance of the user's deployment class.
+
+Reference equivalent: `python/ray/serve/_private/replica.py` — tracks
+ongoing requests (the router's and autoscaler's signal), runs sync user
+code off the event loop, and drains gracefully before shutdown so rolling
+updates drop nothing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Dict, Optional, Tuple
+
+
+class Replica:
+    def __init__(self, cls_factory, init_args: Tuple, init_kwargs: Dict,
+                 deployment_name: str, replica_id: str,
+                 version: Optional[str]):
+        self._instance = cls_factory(*init_args, **init_kwargs)
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        self.version = version
+        self._ongoing = 0
+        self._total = 0
+        self._draining = False
+        self._started_at = time.time()
+
+    # -- data plane ----------------------------------------------------
+    async def handle_request(self, method_name: str, args: Tuple,
+                             kwargs: Dict) -> Any:
+        if self._draining:
+            from ray_tpu.serve.exceptions import ReplicaDrainingError
+
+            raise ReplicaDrainingError(
+                f"replica {self.replica_id} is draining")
+        self._ongoing += 1
+        self._total += 1
+        try:
+            target = self._instance if method_name == "__call__" else None
+            method = (getattr(self._instance, method_name)
+                      if target is None else self._resolve_call())
+            if inspect.iscoroutinefunction(method):
+                return await method(*args, **kwargs)
+            # Sync user code must not block the replica's event loop.
+            return await asyncio.to_thread(method, *args, **kwargs)
+        finally:
+            self._ongoing -= 1
+
+    def _resolve_call(self):
+        call = getattr(self._instance, "__call__", None)
+        if call is None:
+            raise TypeError(
+                f"deployment {self.deployment_name} is not callable; "
+                "define __call__ or route to a named method")
+        return call
+
+    # -- control plane -------------------------------------------------
+    def queue_len(self) -> int:
+        return self._ongoing
+
+    def metrics(self) -> Dict[str, Any]:
+        return {"replica_id": self.replica_id, "ongoing": self._ongoing,
+                "total": self._total, "version": self.version,
+                "draining": self._draining}
+
+    def check_health(self) -> bool:
+        probe = getattr(self._instance, "check_health", None)
+        if probe is not None:
+            probe()
+        return True
+
+    async def prepare_for_shutdown(self, timeout_s: float = 20.0) -> bool:
+        """Stop accepting new requests, wait for in-flight to finish
+        (reference: replica graceful_shutdown loop)."""
+        self._draining = True
+        deadline = time.monotonic() + timeout_s
+        while self._ongoing > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        return self._ongoing == 0
+
+    def reconfigure(self, user_config: Any) -> bool:
+        hook = getattr(self._instance, "reconfigure", None)
+        if hook is not None:
+            hook(user_config)
+        return True

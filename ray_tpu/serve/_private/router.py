@@ -1,0 +1,101 @@
+"""Request router: power-of-two-choices replica selection.
+
+Reference equivalent: `python/ray/serve/_private/router.py:290`
+(PowerOfTwoChoicesReplicaScheduler): keep a cached replica set (refreshed
+from the controller on a version counter), sample two candidates, route to
+the one with the lower queue, retry through drains/deaths.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class Router:
+    def __init__(self, controller_handle, deployment_name: str,
+                 refresh_interval_s: float = 1.0):
+        self._controller = controller_handle
+        self.deployment_name = deployment_name
+        self._refresh_interval_s = refresh_interval_s
+        self._replicas: List[Tuple[str, Any]] = []
+        self._version = -2
+        self._last_refresh = 0.0
+        self._inflight: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def _refresh(self, force: bool = False) -> None:
+        import ray_tpu
+
+        now = time.monotonic()
+        if not force and now - self._last_refresh \
+                < self._refresh_interval_s:
+            return
+        table = ray_tpu.get(
+            self._controller.get_routing_table.remote(
+                self.deployment_name), timeout=30)
+        with self._lock:
+            self._last_refresh = now
+            if table["version"] != self._version:
+                self._version = table["version"]
+                self._replicas = list(table["replicas"])
+                self._inflight = {rid: self._inflight.get(rid, 0)
+                                  for rid, _ in self._replicas}
+
+    def _choose(self) -> Tuple[str, Any]:
+        with self._lock:
+            replicas = list(self._replicas)
+        if not replicas:
+            raise _NoReplicas()
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
+        with self._lock:
+            la = self._inflight.get(a[0], 0)
+            lb = self._inflight.get(b[0], 0)
+        return a if la <= lb else b
+
+    def assign(self, method_name: str, args: tuple, kwargs: dict,
+               timeout_s: float = 30.0):
+        """Pick a replica and submit; returns (replica_id, ObjectRef).
+        Blocks (with backoff) while the deployment has no running
+        replica — e.g. mid-startup."""
+        deadline = time.monotonic() + timeout_s
+        self._refresh()
+        while True:
+            try:
+                replica_id, handle = self._choose()
+                break
+            except _NoReplicas:
+                if time.monotonic() > deadline:
+                    from ray_tpu.serve.exceptions import (
+                        DeploymentUnavailableError)
+
+                    raise DeploymentUnavailableError(
+                        f"no running replicas for "
+                        f"{self.deployment_name!r} after {timeout_s}s")
+                time.sleep(0.05)
+                self._refresh(force=True)
+        with self._lock:
+            self._inflight[replica_id] = \
+                self._inflight.get(replica_id, 0) + 1
+        ref = handle.handle_request.remote(method_name, args, kwargs)
+        return replica_id, ref
+
+    def complete(self, replica_id: str) -> None:
+        with self._lock:
+            if replica_id in self._inflight:
+                self._inflight[replica_id] = max(
+                    0, self._inflight[replica_id] - 1)
+
+    def invalidate(self) -> None:
+        """Force the next assign to re-pull the routing table (a replica
+        died or drained under us)."""
+        self._last_refresh = 0.0
+        self._version = -2
+
+
+class _NoReplicas(Exception):
+    pass

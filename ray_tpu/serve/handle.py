@@ -1,0 +1,102 @@
+"""DeploymentHandle: the Python-native way to call a deployment.
+
+Reference equivalent: `python/ray/serve/handle.py` (DeploymentHandle /
+DeploymentResponse). `handle.remote(...)` routes through the
+power-of-two router and returns a DeploymentResponse whose `result()`
+blocks; `.options(method_name=...)` targets a specific method. Handles
+pickle cleanly (actor args, closures) and rebuild their router lazily.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+
+class DeploymentResponse:
+    def __init__(self, handle: "DeploymentHandle", replica_id: str, ref):
+        self._handle = handle
+        self._replica_id = replica_id
+        self._ref = ref
+        self._done = False
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        import ray_tpu
+        from ray_tpu.serve.exceptions import ReplicaDrainingError
+
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        while True:
+            try:
+                value = ray_tpu.get(self._ref, timeout=timeout_s)
+                self._complete()
+                return value
+            except ReplicaDrainingError:
+                # The replica started draining between routing and
+                # execution: retry on a live one (reference: router
+                # retries RayActorError/drain).
+                self._complete()
+                self._handle._router.invalidate()
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise
+                new = self._handle.remote_method(
+                    self._handle._method_name, self._args, self._kwargs)
+                self._replica_id = new._replica_id
+                self._ref = new._ref
+                # The retry is a fresh assignment with its own inflight
+                # count — arm completion again for the new replica.
+                self._done = False
+            except BaseException:
+                # Application errors and timeouts still finish the
+                # request from the router's perspective — without this
+                # the inflight count leaks and power-of-two steers away
+                # from the replica forever.
+                self._complete()
+                raise
+
+    def _complete(self) -> None:
+        if not self._done:
+            self._done = True
+            self._handle._router.complete(self._replica_id)
+
+    @property
+    def object_ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller_handle,
+                 method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self._controller = controller_handle
+        self._method_name = method_name
+        self.__router = None
+
+    @property
+    def _router(self):
+        if self.__router is None:
+            from ray_tpu.serve._private.router import Router
+
+            self.__router = Router(self._controller, self.deployment_name)
+        return self.__router
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        return DeploymentHandle(self.deployment_name, self._controller,
+                                method_name=method_name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self.remote_method(self._method_name, args, kwargs)
+
+    def remote_method(self, method_name: str, args, kwargs
+                      ) -> DeploymentResponse:
+        replica_id, ref = self._router.assign(method_name, args, kwargs)
+        resp = DeploymentResponse(self, replica_id, ref)
+        resp._args, resp._kwargs = args, kwargs
+        return resp
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self._controller,
+                 self._method_name))

@@ -1,0 +1,247 @@
+"""Serve: controller reconciliation, routing, autoscaling, rolling
+updates, HTTP ingress.
+
+Reference coverage class: `python/ray/serve/tests/test_standalone.py` +
+`test_autoscaling_policy.py` + `test_proxy.py`. BASELINE north-star #5:
+deploy a jitted model, scale replicas under load, rolling update without
+dropped requests.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def serve_instance(ray_cluster):
+    from ray_tpu import serve
+
+    yield serve
+    serve.shutdown()
+
+
+def test_deploy_jitted_model_and_http(serve_instance):
+    """A deployment holding a jitted model answers over handle and HTTP
+    with 2 replicas."""
+    serve = serve_instance
+
+    @serve.deployment(num_replicas=2)
+    class Model:
+        def __init__(self, scale):
+            import jax
+            import jax.numpy as jnp
+
+            jax.config.update("jax_platforms", "cpu")
+            self._fwd = jax.jit(lambda x: (x * scale).sum())
+            self._jnp = jnp
+
+        def __call__(self, req):
+            x = self._jnp.asarray(
+                [float(v) for v in req["x"]], self._jnp.float32)
+            return {"y": float(self._fwd(x))}
+
+    handle = serve.run(Model.bind(3.0), route_prefix="/model")
+    out = handle.remote({"x": [1, 2, 3]}).result(timeout_s=60)
+    assert out["y"] == pytest.approx(18.0)
+
+    port = serve.start()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/model",
+        data=json.dumps({"x": [2, 2]}).encode(),
+        headers={"Content-Type": "application/json"})
+    body = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    assert body["y"] == pytest.approx(12.0)
+
+    st = serve.status()["Model"]
+    assert len([r for r in st["replicas"]
+                if r["state"] == "RUNNING"]) == 2
+
+
+def test_requests_spread_across_replicas(serve_instance):
+    serve = serve_instance
+
+    @serve.deployment(num_replicas=2)
+    class WhoAmI:
+        def __call__(self, _):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(WhoAmI.bind(), route_prefix="/who")
+    # Wait until BOTH replicas are running (serve.run only waits for the
+    # first) so the router's table has both before we measure spread.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        st = serve.status()["WhoAmI"]
+        if len([r for r in st["replicas"]
+                if r["state"] == "RUNNING"]) == 2:
+            break
+        time.sleep(0.1)
+    pids = {handle.remote(None).result(timeout_s=30) for _ in range(20)}
+    assert len(pids) == 2
+
+
+def test_autoscaling_scales_up_under_load(serve_instance):
+    """Queue-length autoscaling grows replicas from 1 toward max under
+    sustained concurrent load (reference: autoscaling_policy.py:12)."""
+    serve = serve_instance
+
+    @serve.deployment(
+        max_ongoing_requests=4,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=3,
+            target_ongoing_requests=1.0, upscale_delay_s=0.2,
+            downscale_delay_s=60.0))
+    class Slow:
+        def __call__(self, _):
+            time.sleep(0.3)
+            return "done"
+
+    handle = serve.run(Slow.bind(), route_prefix="/slow")
+
+    stop = threading.Event()
+    errors = []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                handle.remote(None).result(timeout_s=60)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=hammer) for _ in range(6)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 30
+        peak = 1
+        while time.monotonic() < deadline:
+            st = serve.status()["Slow"]
+            running = [r for r in st["replicas"]
+                       if r["state"] == "RUNNING"]
+            peak = max(peak, len(running))
+            if peak >= 2:
+                break
+            time.sleep(0.25)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, errors[:1]
+    assert peak >= 2, f"autoscaler never scaled up (peak={peak})"
+
+
+def test_rolling_update_no_dropped_requests(serve_instance):
+    """Redeploying a new version keeps serving: no request errors while
+    old replicas drain and new ones take over; afterwards every response
+    is from the new version."""
+    serve = serve_instance
+
+    @serve.deployment(num_replicas=2, version="v1")
+    class Versioned:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def __call__(self, _):
+            time.sleep(0.02)
+            return self.tag
+
+    handle = serve.run(Versioned.bind("v1"), route_prefix="/v")
+    assert handle.remote(None).result(timeout_s=30) == "v1"
+
+    stop = threading.Event()
+    errors = []
+    seen = []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                seen.append(handle.remote(None).result(timeout_s=60))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    serve.run(Versioned.options(version="v2").bind("v2"),
+              route_prefix="/v")
+    # Wait until only-v2 responses remain.
+    deadline = time.monotonic() + 60
+    try:
+        while time.monotonic() < deadline:
+            n = len(seen)
+            time.sleep(0.5)
+            recent = seen[n:]
+            if recent and all(tag == "v2" for tag in recent):
+                break
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, f"dropped requests during rolling update: " \
+                       f"{errors[:1]}"
+    assert "v2" in seen, "update never completed"
+    tail = seen[-5:]
+    assert all(tag == "v2" for tag in tail), tail
+
+
+def test_batching_folds_concurrent_requests(serve_instance):
+    """@serve.batch folds concurrent calls into one vectorized forward
+    (the MXU lever; reference: serve/batching.py)."""
+    serve = serve_instance
+
+    @serve.deployment(max_ongoing_requests=16)
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+        async def handle(self, items):
+            self.batch_sizes.append(len(items))
+            return [x * 2 for x in items]
+
+        async def __call__(self, x):
+            return await self.handle(x)
+
+        def sizes(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batched.bind(), route_prefix="/batched")
+    resps = [handle.remote(i) for i in range(8)]
+    outs = [r.result(timeout_s=60) for r in resps]
+    assert outs == [i * 2 for i in range(8)]
+    sizes = handle.options(method_name="sizes").remote().result(
+        timeout_s=30)
+    assert max(sizes) > 1, f"no batching happened: {sizes}"
+
+
+def test_delete_deployment(serve_instance):
+    serve = serve_instance
+
+    @serve.deployment
+    class Tmp:
+        def __call__(self, _):
+            return 1
+
+    handle = serve.run(Tmp.bind(), route_prefix="/tmp")
+    assert handle.remote(None).result(timeout_s=30) == 1
+    serve.delete("Tmp")
+    assert "Tmp" not in serve.status()
