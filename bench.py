@@ -64,11 +64,28 @@ def main() -> None:
     peak = 197e12 if backend == "tpu" else 1e12
     mfu = (6.0 * n_params * tokens_per_sec) / peak
 
+    # Runtime microbench (ray_perf equivalent): folded into the same JSON
+    # line as `notes` so the driver's one-line contract holds.
+    notes = {}
+    try:
+        import os
+        import subprocess
+        import sys
+
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.perf", "--scale", "0.5"],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        notes = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception:
+        pass
+
     print(json.dumps({
         "metric": "lm_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": f"tokens/s ({n_params/1e6:.0f}M-param LM, {backend})",
         "vs_baseline": round(mfu, 4),
+        "notes": notes,
     }))
 
 

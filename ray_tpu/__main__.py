@@ -1,0 +1,5 @@
+"""`python -m ray_tpu <command>` — see scripts/cli.py."""
+
+from ray_tpu.scripts.cli import main
+
+main()

@@ -15,6 +15,7 @@ copies through the local raylet.
 
 from __future__ import annotations
 
+import asyncio
 import concurrent.futures
 import logging
 import os
@@ -90,12 +91,15 @@ class _ActorState:
 
 class _LeasePool:
     """Per-scheduling-key worker leases (reference: direct_task_transport
-    SchedulingKey entries + pipelined lease requests)."""
+    SchedulingKey entries + pipelined lease requests,
+    max_pending_lease_requests_per_scheduling_category)."""
+
+    MAX_INFLIGHT = 10
 
     def __init__(self):
         self.idle: List[dict] = []
-        self.inflight_leases = 0
-        self.queue: List[Any] = []  # pending (spec, opts, reply_future)
+        self.inflight_leases = 0        # lease RPCs in flight to raylets
+        self.waiters: List[Any] = []    # futures of queued acquires
 
 
 class ClusterRuntime:
@@ -174,6 +178,56 @@ class ClusterRuntime:
         await self._gcs.connect()
         await self._raylet.connect()
         self.address = self._server.address
+        self._event_flusher = asyncio.ensure_future(
+            self._flush_task_events_loop())
+
+    # -- task events (reference: task_event_buffer.h flush loop) --------
+    def _record_task_event(self, task_id: str, name: str, event: str,
+                           job_id: Optional[str] = None, **extra) -> None:
+        from ray_tpu.core.task_events import task_event_buffer
+
+        task_event_buffer().record(
+            task_id, name, event, job_id=job_id or self.job_id.hex(),
+            node_id=self.node_id.hex(), worker_id=self.address,
+            pid=os.getpid(), **extra)
+
+    async def _flush_task_events_loop(self) -> None:
+        from ray_tpu.core.task_events import task_event_buffer
+
+        while True:
+            await asyncio.sleep(1.0)
+            events = task_event_buffer().drain()
+            if not events:
+                continue
+            try:
+                await self._gcs.add_task_events(events)
+            except Exception:
+                pass  # GCS down: events drop (bounded-loss contract)
+
+    def task_events(self, job_id: Optional[str] = None):
+        """Flush this process's buffer and fetch the job's events from
+        the GCS store (the single entry used by timeline + state API)."""
+        from ray_tpu.core.task_events import task_event_buffer
+
+        local = task_event_buffer().drain()
+        if local:
+            try:
+                self._loop.run(self._gcs.add_task_events(local),
+                               timeout=10)
+            except Exception:
+                pass
+        return self._loop.run(
+            self._gcs.get_task_events(job_id), timeout=30)
+
+    def timeline(self, filename: Optional[str] = None):
+        """Chrome-trace export of this job's task events (reference:
+        ray timeline / state_api timeline)."""
+        from ray_tpu.core.task_events import (events_to_chrome_trace,
+                                              write_trace)
+
+        trace = events_to_chrome_trace(
+            self.task_events(self.job_id.hex()))
+        return write_trace(trace, filename)
 
     # -- bring-up helpers ----------------------------------------------
     @classmethod
@@ -468,6 +522,9 @@ class ClusterRuntime:
         if streaming:
             gen = ObjectRefGenerator()
             self._generators[task_id.hex()] = gen
+        self._record_task_event(task_id.hex(),
+                                remote_function._function_name,
+                                "SUBMITTED")
         retain = (not streaming and opts.num_returns != 0
                   and opts.max_retries > 0)
         if retain:
@@ -604,17 +661,72 @@ class ClusterRuntime:
     # -- lease pool ----------------------------------------------------
     async def _acquire_worker(self, key: str, resources: Dict[str, float],
                               pg: Optional[dict] = None) -> dict:
+        """Grab a leased worker for this scheduling key: an idle one
+        immediately, else queue and keep up to MAX_INFLIGHT lease
+        requests pipelined to the raylet. Completed tasks hand their
+        worker straight to the next waiter (no raylet round trip) — this
+        is what makes a burst of small same-shape tasks run at worker
+        speed instead of lease-RPC speed."""
         pool = self._lease_pools.setdefault(key, _LeasePool())
         if pool.idle:
             return pool.idle.pop()
-        bundle = None
-        address = None
-        if pg is not None:
-            address, idx = await self._pg_location(
-                pg["pg_id"], pg["bundle_index"], demand=resources)
-            bundle = (pg["pg_id"], idx)
-        return await self._request_lease(resources, bundle=bundle,
-                                         address=address)
+        fut = asyncio.get_running_loop().create_future()
+        pool.waiters.append(fut)
+        self._pump_leases(pool, resources, pg)
+        return await fut
+
+    def _pump_leases(self, pool: _LeasePool,
+                     resources: Dict[str, float],
+                     pg: Optional[dict]) -> None:
+        while pool.inflight_leases < min(len(pool.waiters),
+                                         _LeasePool.MAX_INFLIGHT):
+            pool.inflight_leases += 1
+            asyncio.ensure_future(self._fetch_lease(pool, resources, pg))
+
+    async def _fetch_lease(self, pool: _LeasePool,
+                           resources: Dict[str, float],
+                           pg: Optional[dict]) -> None:
+        try:
+            bundle = None
+            address = None
+            if pg is not None:
+                address, idx = await self._pg_location(
+                    pg["pg_id"], pg["bundle_index"], demand=resources)
+                bundle = (pg["pg_id"], idx)
+            worker = await self._request_lease(resources, bundle=bundle,
+                                               address=address)
+        except Exception as e:  # noqa: BLE001
+            pool.inflight_leases -= 1
+            for i, fut in enumerate(pool.waiters):
+                if not fut.done():
+                    pool.waiters.pop(i)
+                    fut.set_exception(e)
+                    break
+            # Surplus waiters beyond MAX_INFLIGHT still need lease
+            # requests of their own — without this re-pump they would
+            # wait forever once every inflight request has failed.
+            self._pump_leases(pool, resources, pg)
+            return
+        pool.inflight_leases -= 1
+        self._hand_worker(pool, worker)
+
+    def _hand_worker(self, pool: _LeasePool, worker: dict) -> None:
+        while pool.waiters:
+            fut = pool.waiters.pop(0)
+            if not fut.done():
+                fut.set_result(worker)
+                return
+        pool.idle.append(worker)
+        asyncio.ensure_future(self._linger_then_return(pool, worker))
+
+    async def _linger_then_return(self, pool: _LeasePool,
+                                  worker: dict) -> None:
+        """An idle lease is kept briefly for reuse, then returned so the
+        raylet can reschedule its resources."""
+        await asyncio.sleep(0.05)
+        if worker in pool.idle:
+            pool.idle.remove(worker)
+            await self._return_worker(worker)
 
     async def _request_lease(self, resources: Dict[str, float],
                              is_actor: bool = False,
@@ -641,13 +753,9 @@ class ClusterRuntime:
 
     async def _release_worker(self, key: str, worker: dict) -> None:
         pool = self._lease_pools.setdefault(key, _LeasePool())
-        # Keep the lease for reuse; return it if nothing else is queued.
-        pool.idle.append(worker)
-        import asyncio
-        await asyncio.sleep(0.05)
-        if worker in pool.idle:
-            pool.idle.remove(worker)
-            await self._return_worker(worker)
+        # Hand straight to a queued waiter if any; else idle-cache with a
+        # linger before returning to the raylet.
+        self._hand_worker(pool, worker)
 
     async def _return_worker(self, worker: dict, dead: bool = False) -> None:
         try:
@@ -812,6 +920,8 @@ class ClusterRuntime:
             "owner": self.address,
         }
         refs = self._make_return_refs(task_id, num_returns)
+        self._record_task_event(task_id.hex(), spec["name"], "SUBMITTED",
+                                actor_id=aid)
         gen = None
         if streaming:
             gen = ObjectRefGenerator()
@@ -1334,6 +1444,9 @@ class ClusterRuntime:
         results: List[dict] = []
         token = _set_task_context(
             task_id=TaskID(bytes.fromhex(task_id)))
+        self._record_task_event(task_id, name, "RUNNING",
+                                job_id=spec.get("job_id"))
+        ok = False
         try:
             self._apply_visible_chips(spec.get("visible_chips"))
             self._ensure_job_env(spec.get("job_id"))
@@ -1342,9 +1455,13 @@ class ClusterRuntime:
             value = fn(*args, **kwargs)
             results = self._package_returns(task_id, num_returns, name,
                                             value)
+            ok = True
         except BaseException as e:  # noqa: BLE001
             results = self._package_error(task_id, num_returns, name, e)
         finally:
+            self._record_task_event(
+                task_id, name, "FINISHED" if ok else "FAILED",
+                job_id=spec.get("job_id"))
             _reset_task_context(token)
         return {"results": results}
 
@@ -1440,9 +1557,12 @@ class ClusterRuntime:
         """Isolate this worker process to its granted TPU chips (reference:
         accelerators/tpu.py:214). Must run before user code imports jax."""
         if chips:
+            from ray_tpu.core.jax_platform import enable_host_platform
             from ray_tpu.parallel.tpu import visible_chip_env
 
             os.environ.update(visible_chip_env(chips))
+            # Undo the worker-default CPU pin: this worker owns chips now.
+            enable_host_platform()
 
     async def handle_actor_init(self, conn: ServerConnection, *,
                                 actor_id: str, cls_key: str, args: bytes,
@@ -1498,19 +1618,35 @@ class ClusterRuntime:
         token = _set_task_context(
             task_id=TaskID(bytes.fromhex(task_id)),
             actor_id=ActorID(bytes.fromhex(spec["actor_id"])))
+        self._record_task_event(task_id, name, "RUNNING",
+                                job_id=spec.get("job_id"),
+                                actor_id=spec.get("actor_id"))
+        ok = False
         try:
             self._ensure_job_env(spec.get("job_id"))
-            method = getattr(self._actor_instance, spec["method"])
             args, kwargs = self._resolve_task_args(spec["args"])
-            value = method(*args, **kwargs)
+            if spec["method"] == "__ray_call__":
+                # fn(actor_instance, *args): the system method for running
+                # arbitrary code against a live actor (reference:
+                # __ray_call__ in python/ray/actor.py).
+                fn, args = args[0], args[1:]
+                value = fn(self._actor_instance, *args, **kwargs)
+            else:
+                method = getattr(self._actor_instance, spec["method"])
+                value = method(*args, **kwargs)
             if _inspect.iscoroutine(value):
                 value = asyncio.run_coroutine_threadsafe(
                     value, self._actor_loop).result()
             results = self._package_returns(task_id, num_returns, name,
                                             value)
+            ok = True
         except BaseException as e:  # noqa: BLE001
             results = self._package_error(task_id, num_returns, name, e)
         finally:
+            self._record_task_event(
+                task_id, name, "FINISHED" if ok else "FAILED",
+                job_id=spec.get("job_id"),
+                actor_id=spec.get("actor_id"))
             _reset_task_context(token)
         return {"results": results}
 
@@ -1551,6 +1687,28 @@ class ClusterRuntime:
             "IsHeadNode": n.get("is_head", False),
             "Labels": n.get("labels", {}),
         } for n in raw]
+
+    def object_store_stats(self) -> List[dict]:
+        """Every alive raylet's plasma inventory (state API
+        list_objects / `ray_tpu memory`)."""
+
+        async def collect():
+            out = []
+            for n in await self._gcs.get_nodes():
+                if not n.get("alive"):
+                    continue
+                try:
+                    client = await self._raylet_client(n["address"])
+                    stats = await client.call("object_store_stats",
+                                              timeout=10.0)
+                    for obj in stats["objects"]:
+                        out.append(dict(obj, node_id=stats["node_id"],
+                                        address=n["address"]))
+                except Exception:
+                    continue
+            return out
+
+        return self._loop.run(collect(), timeout=60)
 
     def cluster_resources(self) -> Dict[str, float]:
         out: Dict[str, float] = {}

@@ -81,6 +81,14 @@ class GcsClient:
     async def list_jobs(self) -> List[Dict[str, Any]]:
         return await self.rpc.call("list_jobs")
 
+    # -- task events ------------------------------------------------------
+    async def add_task_events(self, events: List[Dict[str, Any]]) -> bool:
+        return await self.rpc.call("add_task_events", events=events)
+
+    async def get_task_events(self, job_id: Optional[str] = None
+                              ) -> List[Dict[str, Any]]:
+        return await self.rpc.call("get_task_events", job_id=job_id)
+
     # -- kv -------------------------------------------------------------
     async def kv_put(self, key: str, value: bytes,
                      overwrite: bool = True) -> bool:

@@ -37,6 +37,11 @@ class GcsServer:
         self.placement_groups: Dict[str, Dict[str, Any]] = {}
         self.kv: Dict[str, bytes] = {}
         self.workers: Dict[str, Dict[str, Any]] = {}
+        # Task-event store, bounded (reference: GcsTaskManager's
+        # max_num_task_events_stored).
+        from collections import deque
+
+        self.task_events: deque = deque(maxlen=100_000)
         # -- pubsub (reference: InternalPubSub / pubsub/) -----------------
         self._subs: Dict[str, Set[ServerConnection]] = {}
         self._heartbeats: Dict[str, float] = {}
@@ -249,6 +254,22 @@ class GcsServer:
     async def handle_list_jobs(self, conn: ServerConnection
                                ) -> List[Dict[str, Any]]:
         return list(self.jobs.values())
+
+    # ------------------------------------------------------------------
+    # task events (reference: GcsTaskManager + task_event_buffer flushes)
+    # ------------------------------------------------------------------
+    async def handle_add_task_events(self, conn: ServerConnection, *,
+                                     events: List[Dict[str, Any]]) -> bool:
+        self.task_events.extend(events)
+        return True
+
+    async def handle_get_task_events(
+            self, conn: ServerConnection, *,
+            job_id: Optional[str] = None) -> List[Dict[str, Any]]:
+        events = list(self.task_events)
+        if job_id is not None:
+            events = [e for e in events if e.get("job_id") == job_id]
+        return events
 
     # ------------------------------------------------------------------
     # internal KV (reference: GcsKvManager / InternalKV service)

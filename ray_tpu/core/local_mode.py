@@ -333,16 +333,32 @@ class LocalModeRuntime:
         num_returns = opts.num_returns
 
         def run():
+            from ray_tpu.core.task_events import task_event_buffer
+
             token = _set_task_context(task_id=task_id)
+            buf = task_event_buffer()
+            job = self.job_id.hex()
+            buf.record(task_id.hex(), name, "RUNNING", job_id=job,
+                       node_id="local", worker_id="local")
+            ok = False
             try:
                 rargs, rkwargs = self._resolve_args(args, kwargs)
                 result = fn(*rargs, **rkwargs)
                 self._store_returns(task_id, num_returns, result)
+                ok = True
             except BaseException as e:  # noqa: BLE001
                 self._store_error(task_id, num_returns, name, e)
             finally:
+                buf.record(task_id.hex(), name,
+                           "FINISHED" if ok else "FAILED", job_id=job,
+                           node_id="local", worker_id="local")
                 _reset_task_context(token)
 
+        from ray_tpu.core.task_events import task_event_buffer
+
+        task_event_buffer().record(
+            task_id.hex(), name, "SUBMITTED", job_id=self.job_id.hex(),
+            node_id="local", worker_id="local")
         self._task_futures[task_id] = self._pool.submit(run)
         refs = self._make_return_refs(task_id, max(num_returns, 1))
         self._task_returns[task_id] = [r.id() for r in refs]
@@ -447,9 +463,13 @@ class LocalModeRuntime:
             asyncio task gets its own contextvars copy)."""
             import asyncio
 
-            method = getattr(actor.instance, method_name)
             rargs, rkwargs = self._resolve_args(args, kwargs)
-            result = method(*rargs, **rkwargs)
+            if method_name == "__ray_call__":
+                fn, rargs = rargs[0], rargs[1:]
+                result = fn(actor.instance, *rargs, **rkwargs)
+            else:
+                method = getattr(actor.instance, method_name)
+                result = method(*rargs, **rkwargs)
             if inspect.iscoroutine(result):
                 async def with_ctx():
                     token = _set_task_context(
@@ -533,6 +553,20 @@ class LocalModeRuntime:
 
     def available_resources(self) -> Dict[str, float]:
         return self.cluster_resources()
+
+    def task_events(self, job_id: Optional[str] = None):
+        from ray_tpu.core.task_events import task_event_buffer
+
+        return task_event_buffer().snapshot(job_id)
+
+    def timeline(self, filename: Optional[str] = None):
+        """Chrome-trace export of the in-process task events."""
+        from ray_tpu.core.task_events import (events_to_chrome_trace,
+                                              write_trace)
+
+        trace = events_to_chrome_trace(
+            self.task_events(self.job_id.hex()))
+        return write_trace(trace, filename)
 
     # -- internal kv (reference: GcsKvManager) ---------------------------
     def kv_put(self, key: bytes, value: bytes, overwrite: bool = True) -> bool:

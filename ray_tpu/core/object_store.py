@@ -121,6 +121,13 @@ class LocalObjectStore:
         if entry is not None:
             entry.pins.discard(worker_id)
 
+    def object_inventory(self) -> list:
+        """Resident-object inventory (reference: `ray memory` /
+        object_store_stats)."""
+        return [{"object_id": oid, "size": e.size, "sealed": e.sealed,
+                 "created_at": e.created_at, "num_pins": len(e.pins)}
+                for oid, e in self._objects.items()]
+
     # -- delete/evict ----------------------------------------------------
     def delete(self, oid: str) -> bool:
         entry = self._objects.pop(oid, None)

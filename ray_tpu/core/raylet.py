@@ -286,6 +286,14 @@ class Raylet:
             spillback_count: int = 0,
             bundle: Optional[List[Any]] = None) -> Dict[str, Any]:
         demand = {k: float(v) for k, v in resources.items() if v}
+        if logger.isEnabledFor(logging.DEBUG):
+            logger.debug(
+                "lease request %s actor=%s spill=%d avail=%s idle=%d "
+                "pending=%d", demand, is_actor, spillback_count,
+                {k: round(v, 1)
+                 for k, v in self.resources_available.items()
+                 if k in ("CPU", "TPU")},
+                len(self._idle), len(self._pending))
         if bundle is not None:
             # Leases against a PG bundle are pinned to this node: no
             # spillback, fail fast if the bundle is gone or can't fit.
@@ -331,6 +339,14 @@ class Raylet:
 
     def _feasible_locally(self, demand: Dict[str, float]) -> bool:
         return self._fits(self.resources_total, demand)
+
+    async def handle_object_store_stats(self, conn: ServerConnection
+                                        ) -> Dict[str, Any]:
+        """Plasma inventory for `ray_tpu memory` / state API
+        list_objects."""
+        return {"node_id": self.node_id, "used": self.store.used,
+                "capacity": self.store.capacity,
+                "objects": self.store.object_inventory()}
 
     def _lease_source(self, pending: "_PendingLease"
                       ) -> Optional[Dict[str, float]]:

@@ -1,0 +1,117 @@
+"""Runtime microbenchmarks: tasks/s, actor calls/s, put/get latency.
+
+Reference equivalent: `python/ray/_private/ray_perf.py` — the numbers the
+reference budgets at 50-300 µs/task (SURVEY §3.2). Run directly:
+
+    python -m ray_tpu.perf            # cluster mode (multi-process)
+    python -m ray_tpu.perf --local    # local mode (in-process)
+
+Prints one JSON object; also importable (`run_microbench`) so bench.py
+and tests can embed the numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List
+
+
+def _noop():
+    return None
+
+
+def _p50(samples: List[float]) -> float:
+    s = sorted(samples) or [float("nan")]
+    return s[len(s) // 2]
+
+
+class _Counter:
+    def __init__(self):
+        self.n = 0
+
+    def inc(self):
+        self.n += 1
+        return self.n
+
+    async def ainc(self):
+        self.n += 1
+        return self.n
+
+
+def run_microbench(local_mode: bool = False,
+                   scale: float = 1.0) -> Dict[str, Any]:
+    """Returns {metric: value} — throughputs in ops/s, latencies in ms."""
+    import numpy as np
+
+    import ray_tpu
+
+    ray_tpu.init(local_mode=local_mode,
+                 **({} if local_mode else {"num_cpus": 4}),
+                 ignore_reinit_error=True)
+    noop = ray_tpu.remote(_noop)
+    out: Dict[str, Any] = {"mode": "local" if local_mode else "cluster"}
+
+    # Warmup (worker spawn, function export).
+    ray_tpu.get([noop.remote() for _ in range(10)], timeout=120)
+
+    # 1. Task throughput: N in-flight no-ops, batched get.
+    n = max(1, int(300 * scale))
+    t0 = time.perf_counter()
+    ray_tpu.get([noop.remote() for _ in range(n)], timeout=300)
+    dt = time.perf_counter() - t0
+    out["tasks_per_s"] = round(n / dt, 1)
+
+    # 2. Sequential task round-trip p50 (submit -> result).
+    lat = []
+    for _ in range(max(1, int(50 * scale))):
+        t0 = time.perf_counter()
+        ray_tpu.get(noop.remote(), timeout=60)
+        lat.append(time.perf_counter() - t0)
+    out["task_roundtrip_p50_ms"] = round(_p50(lat) * 1e3, 3)
+
+    # 3. Actor method calls: sequential p50 + pipelined throughput.
+    counter_cls = ray_tpu.remote(num_cpus=0)(_Counter)
+    counter = counter_cls.remote()
+    ray_tpu.get(counter.inc.remote(), timeout=120)
+    lat = []
+    for _ in range(max(1, int(50 * scale))):
+        t0 = time.perf_counter()
+        ray_tpu.get(counter.inc.remote(), timeout=60)
+        lat.append(time.perf_counter() - t0)
+    out["actor_call_p50_ms"] = round(_p50(lat) * 1e3, 3)
+    n = max(1, int(500 * scale))
+    t0 = time.perf_counter()
+    ray_tpu.get([counter.inc.remote() for _ in range(n)], timeout=300)
+    dt = time.perf_counter() - t0
+    out["actor_calls_per_s"] = round(n / dt, 1)
+
+    # 4. Object plane: 10 MB put + get (zero-copy read path).
+    arr = np.zeros(10 * 1024 * 1024 // 4, np.float32)
+    t0 = time.perf_counter()
+    ref = ray_tpu.put(arr)
+    out["put_10mb_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+    t0 = time.perf_counter()
+    ray_tpu.get(ref, timeout=60)
+    out["get_10mb_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+
+    ray_tpu.kill(counter)
+    return out
+
+
+def main() -> None:
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--local", action="store_true")
+    p.add_argument("--scale", type=float, default=1.0)
+    args = p.parse_args()
+    import ray_tpu
+
+    result = run_microbench(local_mode=args.local, scale=args.scale)
+    print(json.dumps(result))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

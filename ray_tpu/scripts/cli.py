@@ -1,0 +1,190 @@
+"""ray_tpu command line: cluster lifecycle + introspection.
+
+Reference equivalent: `python/ray/scripts/scripts.py` (`ray start`,
+`ray status`, `ray list`, `ray summary`, `ray memory`, `ray timeline`) —
+the subset that matters without a dashboard. Entry: `python -m ray_tpu
+<command>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+
+def _connect(address: Optional[str]):
+    import ray_tpu
+
+    addr = address or os.environ.get("RAY_TPU_ADDRESS")
+    if not addr:
+        sys.exit("no cluster address: pass --address or set "
+                 "RAY_TPU_ADDRESS (printed by `ray_tpu start --head`)")
+    ray_tpu.init(address=addr)
+    return ray_tpu
+
+
+def cmd_start(args) -> None:
+    if args.head:
+        from ray_tpu.core.node import NodeSupervisor
+
+        node = NodeSupervisor.start_head(num_cpus=args.num_cpus)
+        print(f"GCS address: {node.gcs_address}", flush=True)
+        print(f"raylet address: {node.raylet_address}")
+        print(f"session dir: {node.session_dir}")
+        print("To connect: ray_tpu.init(address="
+              f"{node.gcs_address!r}) or export "
+              f"RAY_TPU_ADDRESS={node.gcs_address}", flush=True)
+        if args.block:
+            print("--block: serving until Ctrl-C")
+            try:
+                while True:
+                    time.sleep(3600)
+            except KeyboardInterrupt:
+                pass
+        else:
+            # Detach: the daemons are children; keep a supervisor alive.
+            print("daemons running; this process supervises them "
+                  "(Ctrl-C to stop the node)")
+            try:
+                for proc in node.processes.values():
+                    proc.wait()
+            except KeyboardInterrupt:
+                pass
+        return
+    if not args.address:
+        sys.exit("worker node needs --address=<gcs address>")
+    import json as _json
+    import subprocess
+
+    from ray_tpu.core.ids import NodeID
+    from ray_tpu.core.node import detect_node_resources, _wait_for_line
+
+    node_id = NodeID.from_random().hex()
+    cmd = [sys.executable, "-m", "ray_tpu.core.raylet",
+           "--gcs", args.address, "--node-id", node_id,
+           "--resources",
+           _json.dumps(detect_node_resources(args.num_cpus))]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE)
+    raylet_addr = _wait_for_line(proc, r"RAYLET_ADDRESS=(\S+)")
+    print(f"raylet {node_id[:8]} joined at {raylet_addr}")
+    try:
+        proc.wait()
+    except KeyboardInterrupt:
+        proc.terminate()
+
+
+def cmd_status(args) -> None:
+    ray_tpu = _connect(args.address)
+    nodes = ray_tpu.nodes()
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    print(f"nodes: {len([n for n in nodes if n['Alive']])} alive / "
+          f"{len(nodes)} total")
+    for n in nodes:
+        state = "ALIVE" if n["Alive"] else "DEAD"
+        head = " (head)" if n.get("IsHeadNode") else ""
+        print(f"  {n['NodeID'][:8]} {state}{head} "
+              f"{n.get('NodeManagerAddress', '')} "
+              f"{n.get('Resources', {})}")
+    print("resources:")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0.0):g} / {total[k]:g} available")
+    ray_tpu.shutdown()
+
+
+def cmd_list(args) -> None:
+    ray_tpu = _connect(args.address)
+    from ray_tpu.util import state
+
+    fetch = {"tasks": state.list_tasks, "actors": state.list_actors,
+             "objects": state.list_objects, "nodes": state.list_nodes,
+             "placement-groups": state.list_placement_groups}
+    rows = fetch[args.kind]()
+    print(json.dumps(rows, indent=2, default=str))
+    ray_tpu.shutdown()
+
+
+def cmd_summary(args) -> None:
+    ray_tpu = _connect(args.address)
+    from ray_tpu.util.state import summarize_tasks
+
+    print(json.dumps(summarize_tasks(), indent=2))
+    ray_tpu.shutdown()
+
+
+def cmd_memory(args) -> None:
+    ray_tpu = _connect(args.address)
+    from ray_tpu.util.state import list_objects
+
+    objs = list_objects()
+    total = sum(o["size"] for o in objs)
+    print(f"{len(objs)} objects, {total / 1e6:.1f} MB total")
+    for o in sorted(objs, key=lambda x: -x["size"])[:args.limit]:
+        print(f"  {o['object_id'][:16]} {o['size'] / 1e6:8.2f} MB "
+              f"pins={o['num_pins']} node={o.get('node_id', '')[:8]}")
+    ray_tpu.shutdown()
+
+
+def cmd_timeline(args) -> None:
+    ray_tpu = _connect(args.address)
+    trace = ray_tpu.timeline(args.output)
+    print(f"{len(trace)} trace events"
+          + (f" written to {args.output}" if args.output else ""))
+    ray_tpu.shutdown()
+
+
+def cmd_perf(args) -> None:
+    from ray_tpu.perf import run_microbench
+
+    print(json.dumps(run_microbench(local_mode=args.local)))
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("start", help="start a head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", help="GCS address to join (worker node)")
+    sp.add_argument("--num-cpus", type=int, default=None)
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("status", help="cluster nodes + resources")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("kind", choices=["tasks", "actors", "objects",
+                                     "nodes", "placement-groups"])
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="task counts by name/state")
+    sp.add_argument("--address")
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("memory", help="object store contents")
+    sp.add_argument("--address")
+    sp.add_argument("--limit", type=int, default=20)
+    sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("timeline", help="chrome-trace task timeline")
+    sp.add_argument("--address")
+    sp.add_argument("--output", "-o", default=None)
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("perf", help="runtime microbenchmarks")
+    sp.add_argument("--local", action="store_true")
+    sp.set_defaults(fn=cmd_perf)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()
