@@ -89,6 +89,10 @@ class _ActorState:
         self.pinned_args: List[ObjectID] = []  # ctor-arg refs, pinned until DEAD
 
 
+class _TaskCancelledBeforePush(Exception):
+    """Internal: cancel() landed while the task was queued for a lease."""
+
+
 class _LeasePool:
     """Per-scheduling-key worker leases (reference: direct_task_transport
     SchedulingKey entries + pipelined lease requests,
@@ -136,6 +140,22 @@ class ClusterRuntime:
         self._generators: Dict[str, ObjectRefGenerator] = {}
         self._put_counter = _Counter()
         self._lease_pools: Dict[str, _LeasePool] = {}
+        # cancel(): owner-side cancel flags + where each task is running
+        # (address, is_actor_task).
+        self._cancel_requested: set = set()
+        self._inflight_task_workers: Dict[str, Tuple[str, bool]] = {}
+        # worker-side: task_id -> executing thread ident (for async-raise)
+        self._running_task_threads: Dict[str, int] = {}
+        # worker-side: task_id -> run_coroutine_threadsafe future (async
+        # actor methods cancel through the coroutine, not the thread)
+        self._running_task_cfuts: Dict[str, Any] = {}
+        # worker-side: cancels that arrived before their task started
+        self._cancelled_pending: set = set()
+        # worker-side actor sequencing: caller address -> {next, cond}
+        self._actor_seq: Dict[str, dict] = {}
+        # driver-side: actor_id -> next seq to stamp
+        self._actor_call_seq: Dict[str, int] = {}
+        self._actor_seq_lock = threading.Lock()
         self._raylet_clients: Dict[str, RpcClient] = {self.raylet_address:
                                                       self._raylet}
         self._actors: Dict[str, _ActorState] = {}
@@ -442,24 +462,10 @@ class ClusterRuntime:
             out.append(self._fetch(ref, remaining))
         return out[0] if single else out
 
-    def _is_ready(self, ref: ObjectRef) -> bool:
-        oid = ref.hex()
-        with self._owned_lock:
-            entry = self._owned.get(oid)
-        if entry is not None:
-            return entry.fut.done()
-        owner = ref.owner_address
-        owner = owner.decode() if isinstance(owner, bytes) else owner
-        try:
-            loc = self._loop.run(self._ask_owner_locations(owner, oid),
-                                 timeout=10)
-        except Exception:
-            return False
-        return loc is not None and not loc.get("pending")
-
-    async def _ask_owner_locations(self, owner_addr: str, oid: str):
+    async def _ask_owner_locations_batch(self, owner_addr: str,
+                                         oids: List[str]):
         client = await self._worker_client(owner_addr)
-        return await client.call("get_object_locations", oid=oid,
+        return await client.call("get_object_locations_batch", oids=oids,
                                  timeout=10.0)
 
     def wait(self, refs, num_returns: int = 1,
@@ -475,16 +481,43 @@ class ClusterRuntime:
                     else time.monotonic() + timeout)
         ready: List[ObjectRef] = []
         pending = list(refs)
+        tick = 0.002
         while len(ready) < num_returns:
+            # Owned refs resolve on local futures (no RPC); borrowed refs
+            # are batched into one locations RPC per owner per tick.
+            borrowed: Dict[str, List[ObjectRef]] = {}
             for ref in list(pending):
-                if self._is_ready(ref):
-                    ready.append(ref)
-                    pending.remove(ref)
+                oid = ref.hex()
+                with self._owned_lock:
+                    entry = self._owned.get(oid)
+                if entry is not None:
+                    if entry.fut.done():
+                        ready.append(ref)
+                        pending.remove(ref)
+                    continue
+                owner = ref.owner_address
+                owner = (owner.decode() if isinstance(owner, bytes)
+                         else owner)
+                borrowed.setdefault(owner, []).append(ref)
+            for owner, owner_refs in borrowed.items():
+                if len(ready) >= num_returns:
+                    break
+                try:
+                    locs = self._loop.run(self._ask_owner_locations_batch(
+                        owner, [r.hex() for r in owner_refs]), timeout=15)
+                except Exception:
+                    continue
+                for ref in owner_refs:
+                    loc = locs.get(ref.hex())
+                    if loc is not None and not loc.get("pending"):
+                        ready.append(ref)
+                        pending.remove(ref)
             if len(ready) >= num_returns:
                 break
             if deadline is not None and time.monotonic() >= deadline:
                 break
-            time.sleep(0.005)
+            time.sleep(tick)
+            tick = min(tick * 2, 0.05)  # back off toward 50 ms
         return ready, pending
 
     # ==================================================================
@@ -581,6 +614,11 @@ class ClusterRuntime:
                     await self._run_on_leased_worker(spec)
                     return
                 except (ConnectionLost, RpcError) as e:
+                    if spec["task_id"] in self._cancel_requested:
+                        # A force-cancel kills the worker mid-task; that
+                        # must surface as cancellation, not retry.
+                        self._fail_task_cancelled(spec, refs)
+                        return
                     attempt += 1
                     if attempt > max(retries, 0):
                         self._fail_task(
@@ -593,12 +631,29 @@ class ClusterRuntime:
                     if delay:
                         import asyncio
                         await asyncio.sleep(delay)
+                except _TaskCancelledBeforePush:
+                    self._fail_task_cancelled(spec, refs)
+                    return
                 except Exception as e:  # noqa: BLE001
                     self._fail_task(spec, refs, f"submission failed: {e}")
                     return
         finally:
             if pinned:
                 self._unpin_args(pinned)
+
+    def _fail_task_cancelled(self, spec: dict,
+                             refs: List[ObjectRef]) -> None:
+        self._cancel_requested.discard(spec["task_id"])
+        err = serialization.serialize_error(
+            TaskCancelledError(spec["task_id"]))
+        blob = err.to_bytes()
+        for r in refs:
+            entry = self._owned_entry(r.hex())
+            if not entry.fut.done():
+                entry.fut.set_result(("inline", blob))
+        gen = self._generators.pop(spec["task_id"], None)
+        if gen is not None:
+            gen._finish(TaskCancelledError(spec["task_id"]))
 
     def _fail_task(self, spec: dict, refs: List[ObjectRef],
                    message: str) -> None:
@@ -621,14 +676,25 @@ class ClusterRuntime:
                f":{pg['pg_id']}:{pg['bundle_index']}" if pg else
                f"{spec['fn_key']}:{sorted(spec['resources'].items())}")
         worker = await self._acquire_worker(key, spec["resources"], pg=pg)
+        if spec["task_id"] in self._cancel_requested:
+            # Cancelled while queued for a lease: never push.
+            await self._release_worker(key, worker)
+            raise _TaskCancelledBeforePush()
         if worker.get("chip_ids"):
             spec = dict(spec, visible_chips=worker["chip_ids"])
+        self._inflight_task_workers[spec["task_id"]] = (
+            worker["worker_address"], False)
         try:
             client = await self._worker_client(worker["worker_address"])
             reply = await client.call("push_task", spec=spec, timeout=None)
         except Exception:
             await self._return_worker(worker, dead=True)
             raise
+        finally:
+            self._inflight_task_workers.pop(spec["task_id"], None)
+        # Only a completed task clears its cancel flag — on a push
+        # failure _submit_async must still see it to suppress the retry.
+        self._cancel_requested.discard(spec["task_id"])
         self._record_task_reply(spec, reply)
         await self._release_worker(key, worker)
 
@@ -805,6 +871,15 @@ class ClusterRuntime:
         # then holds only its explicit demand (reference actor defaults).
         running_demand = resource_demand(opts)
         demand = running_demand or {"CPU": 1.0}
+        detached = opts.lifetime == "detached"
+        if opts.lifetime not in (None, "detached", "non_detached"):
+            raise ValueError(
+                f"lifetime must be None, 'detached' or 'non_detached', "
+                f"got {opts.lifetime!r}")
+        if detached and not opts.name:
+            raise ValueError(
+                "detached actors must be named: they are reached via "
+                "get_actor(name) after their creator exits")
         info = {
             "class_name": actor_class._class_name,
             "name": opts.name,
@@ -813,6 +888,8 @@ class ClusterRuntime:
             "owner": self.address,
             "state": "PENDING",
             "max_restarts": opts.max_restarts,
+            "job_id": self.job_id.hex(),
+            "detached": detached,
             "method_meta": {k: {kk: vv for kk, vv in m.items()}
                             for k, m in meta.items()},
         }
@@ -826,6 +903,7 @@ class ClusterRuntime:
         state.creation = {
             "cls_key": cls_key,
             "args": args_blob,
+            "detached": detached,
             "demand": demand,
             "release_after_start": {} if running_demand else demand,
             "max_concurrency": opts.max_concurrency,
@@ -893,7 +971,9 @@ class ClusterRuntime:
         await raylet_client.call(
             "mark_actor_worker", worker_id=worker["worker_id"],
             actor_id=state.actor_id_hex,
-            release=creation.get("release_after_start") or None, timeout=5.0)
+            release=creation.get("release_after_start") or None,
+            job_id=self.job_id.hex(),
+            detached=creation.get("detached", False), timeout=5.0)
         state.address = worker["worker_address"]
         state.client = client
         state.state = "ALIVE"
@@ -908,6 +988,9 @@ class ClusterRuntime:
         streaming = opts.num_returns in ("streaming", "dynamic")
         num_returns = 1 if streaming else opts.num_returns
         args_blob, pinned = self._serialize_args(args, kwargs)
+        with self._actor_seq_lock:
+            seq = self._actor_call_seq.get(aid, 0)
+            self._actor_call_seq[aid] = seq + 1
         spec = {
             "task_id": task_id.hex(),
             "job_id": self.job_id.hex(),
@@ -918,6 +1001,7 @@ class ClusterRuntime:
             "num_returns": num_returns,
             "streaming": streaming,
             "owner": self.address,
+            "seq": seq,
         }
         refs = self._make_return_refs(task_id, num_returns)
         self._record_task_event(task_id.hex(), spec["name"], "SUBMITTED",
@@ -966,7 +1050,15 @@ class ClusterRuntime:
                                   ) -> None:
         aid = spec["actor_id"]
         try:
+            if spec["task_id"] in self._cancel_requested:
+                # Cancelled before the push left this process.
+                self._fail_task_cancelled(spec, refs)
+                return
             client = await self._actor_client(aid)
+            state = self._actors.get(aid)
+            if state is not None and state.address:
+                self._inflight_task_workers[spec["task_id"]] = (
+                    state.address, True)
             reply = await client.call("push_actor_task", spec=spec,
                                       timeout=None)
             self._record_task_reply(spec, reply)
@@ -989,6 +1081,8 @@ class ClusterRuntime:
             self._fail_actor_task(
                 spec, refs, RayActorError(error_msg=str(e)))
         finally:
+            self._inflight_task_workers.pop(spec["task_id"], None)
+            self._cancel_requested.discard(spec["task_id"])
             if pinned:
                 self._unpin_args(pinned)
 
@@ -1049,6 +1143,9 @@ class ClusterRuntime:
         if no_restart and state is not None:
             state.restarts_remaining = 0
             state.creation = None
+        if no_restart:
+            with self._actor_seq_lock:
+                self._actor_call_seq.pop(aid, None)
 
         async def _kill():
             try:
@@ -1092,8 +1189,62 @@ class ClusterRuntime:
 
     def cancel(self, ref: ObjectRef, force: bool = False,
                recursive: bool = True) -> None:
-        # Best-effort: tasks already pushed cannot be preempted in v1.
-        pass
+        """Cancel the task that produces `ref` (reference:
+        core_worker cancellation: queued tasks are dropped; running
+        tasks get TaskCancelledError raised in their thread; force=True
+        kills the executing worker process)."""
+        task_hex = ref.id().task_id().hex()
+        inflight = self._inflight_task_workers.get(task_hex)
+        if inflight is not None and inflight[1] and force:
+            # Reference parity: force-killing an actor task would kill
+            # the whole actor (collateral damage to every other caller).
+            raise ValueError(
+                "force=True is not supported for actor tasks; use "
+                "ray_tpu.kill on the actor instead")
+        self._cancel_requested.add(task_hex)
+        if inflight is None:
+            return  # queued (or already done): handled at push time
+        address = inflight[0]
+
+        async def _cancel():
+            try:
+                client = await self._worker_client(address)
+                await client.call("cancel_task", task_id=task_hex,
+                                  force=force, timeout=10.0)
+            except Exception:
+                pass  # worker already gone
+
+        self._loop.run(_cancel(), timeout=15)
+
+    async def handle_cancel_task(self, conn: ServerConnection, *,
+                                 task_id: str,
+                                 force: bool = False) -> dict:
+        """Worker-side: interrupt the execution of `task_id` — cancel its
+        coroutine (async actor methods), async-raise in its thread (sync
+        code), or mark it cancelled-before-start."""
+        thread_id = self._running_task_threads.get(task_id)
+        if thread_id is None:
+            # Not started yet (queued behind the actor's concurrency or
+            # seq gate): poison it so execution aborts immediately.
+            self._cancelled_pending.add(task_id)
+            return {"found": False}
+        if force:
+            # Reference force-cancel kills the worker process; the raylet
+            # monitor reaps it and the owner sees ConnectionLost.
+            os._exit(137)
+        cfut = self._running_task_cfuts.get(task_id)
+        if cfut is not None:
+            # Async method: the executor thread is parked in
+            # cfut.result() where an async-raise cannot land — cancel
+            # the coroutine instead.
+            cfut.cancel()
+            return {"found": True}
+        import ctypes
+
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(thread_id),
+            ctypes.py_object(TaskCancelledError))
+        return {"found": True}
 
     # ==================================================================
     # placement groups (reference: python/ray/util/placement_group.py:41 +
@@ -1302,6 +1453,18 @@ class ClusterRuntime:
             return {"inline": payload}
         return {"nodes": list(entry.nodes)}
 
+    async def handle_get_object_locations_batch(
+            self, conn: ServerConnection, *,
+            oids: List[str]) -> Dict[str, Optional[dict]]:
+        """Batched location query: one RPC resolves every ref this caller
+        is waiting on (reference: batched WaitRequest — kills the
+        per-ref-per-tick polling storm)."""
+        out: Dict[str, Optional[dict]] = {}
+        for oid in oids:
+            out[oid] = await self.handle_get_object_locations(conn,
+                                                              oid=oid)
+        return out
+
     async def handle_generator_item(self, conn: ServerConnection, *,
                                     task_id: str, oid: str,
                                     inline: Optional[bytes] = None,
@@ -1446,8 +1609,11 @@ class ClusterRuntime:
             task_id=TaskID(bytes.fromhex(task_id)))
         self._record_task_event(task_id, name, "RUNNING",
                                 job_id=spec.get("job_id"))
+        self._running_task_threads[task_id] = threading.get_ident()
         ok = False
         try:
+            if task_id in self._cancelled_pending:
+                raise TaskCancelledError(task_id)
             self._apply_visible_chips(spec.get("visible_chips"))
             self._ensure_job_env(spec.get("job_id"))
             fn = self._fn.fetch(spec["fn_key"])
@@ -1459,6 +1625,8 @@ class ClusterRuntime:
         except BaseException as e:  # noqa: BLE001
             results = self._package_error(task_id, num_returns, name, e)
         finally:
+            self._running_task_threads.pop(task_id, None)
+            self._cancelled_pending.discard(task_id)
             self._record_task_event(
                 task_id, name, "FINISHED" if ok else "FAILED",
                 job_id=spec.get("job_id"))
@@ -1621,8 +1789,11 @@ class ClusterRuntime:
         self._record_task_event(task_id, name, "RUNNING",
                                 job_id=spec.get("job_id"),
                                 actor_id=spec.get("actor_id"))
+        self._running_task_threads[task_id] = threading.get_ident()
         ok = False
         try:
+            if task_id in self._cancelled_pending:
+                raise TaskCancelledError(task_id)
             self._ensure_job_env(spec.get("job_id"))
             args, kwargs = self._resolve_task_args(spec["args"])
             if spec["method"] == "__ray_call__":
@@ -1635,14 +1806,23 @@ class ClusterRuntime:
                 method = getattr(self._actor_instance, spec["method"])
                 value = method(*args, **kwargs)
             if _inspect.iscoroutine(value):
-                value = asyncio.run_coroutine_threadsafe(
-                    value, self._actor_loop).result()
+                cfut = asyncio.run_coroutine_threadsafe(
+                    value, self._actor_loop)
+                self._running_task_cfuts[task_id] = cfut
+                try:
+                    value = cfut.result()
+                except concurrent.futures.CancelledError:
+                    raise TaskCancelledError(task_id)
+                finally:
+                    self._running_task_cfuts.pop(task_id, None)
             results = self._package_returns(task_id, num_returns, name,
                                             value)
             ok = True
         except BaseException as e:  # noqa: BLE001
             results = self._package_error(task_id, num_returns, name, e)
         finally:
+            self._running_task_threads.pop(task_id, None)
+            self._cancelled_pending.discard(task_id)
             self._record_task_event(
                 task_id, name, "FINISHED" if ok else "FAILED",
                 job_id=spec.get("job_id"),
@@ -1652,16 +1832,72 @@ class ClusterRuntime:
 
     async def handle_push_actor_task(self, conn: ServerConnection, *,
                                      spec: dict) -> dict:
-        import asyncio
-
         if self._actor_instance is None:
             raise RpcError("no actor instance on this worker")
         if spec.get("streaming"):
+            await self._await_actor_turn(spec)
+            self._advance_actor_turn(spec)
             return await self._execute_streaming(spec, actor=True)
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(
+        await self._await_actor_turn(spec)
+        fut = loop.run_in_executor(
             self._actor_executor or self._exec_pool,
             self._execute_actor_method, spec)
+        self._advance_actor_turn(spec)
+        return await fut
+
+    # Explicit per-caller sequencing (reference:
+    # sequential_actor_submit_queue.h): the caller stamps each actor task
+    # with a monotonically increasing seq; dispatch here is gated so a
+    # task never STARTS before its predecessors from the same caller,
+    # regardless of any future awaits added earlier in this handler.
+    def _actor_seq_entry(self, caller: str) -> dict:
+        entry = self._actor_seq.get(caller)
+        if entry is None:
+            if len(self._actor_seq) >= 256:
+                # Bound per-caller state: drop idle entries (no waiters —
+                # long-gone callers); adopt-first-seen re-seeds any that
+                # come back.
+                for key, e in list(self._actor_seq.items()):
+                    if not e["cond"]._waiters:
+                        del self._actor_seq[key]
+            entry = {"next": None, "cond": asyncio.Condition()}
+            self._actor_seq[caller] = entry
+        return entry
+
+    async def _await_actor_turn(self, spec: dict) -> None:
+        seq = spec.get("seq")
+        if seq is None:
+            return
+        entry = self._actor_seq_entry(spec.get("owner", ""))
+        async with entry["cond"]:
+            if entry["next"] is None:
+                # First task seen from this caller (fresh worker, or the
+                # caller reconnected after a restart): adopt its seq.
+                entry["next"] = seq
+            while entry["next"] < seq:
+                try:
+                    await asyncio.wait_for(entry["cond"].wait(),
+                                           timeout=60.0)
+                except asyncio.TimeoutError:
+                    # A predecessor seq was consumed caller-side but its
+                    # push never arrived (e.g. failed before send):
+                    # liveness over strictness — adopt this seq.
+                    entry["next"] = seq
+
+    def _advance_actor_turn(self, spec: dict) -> None:
+        seq = spec.get("seq")
+        if seq is None:
+            return
+        entry = self._actor_seq_entry(spec.get("owner", ""))
+
+        async def bump():
+            async with entry["cond"]:
+                if entry["next"] is not None and entry["next"] == seq:
+                    entry["next"] = seq + 1
+                entry["cond"].notify_all()
+
+        asyncio.ensure_future(bump())
 
     async def handle_exit_worker(self, conn: ServerConnection) -> bool:
         import asyncio

@@ -27,8 +27,14 @@ logger = logging.getLogger(__name__)
 
 
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 storage_path: Optional[str] = None):
         self._rpc = RpcServer(self, host, port)
+        # Durable table storage (reference: gcs redis_store_client /
+        # observable_store_client): load at boot, snapshot when dirty.
+        self._storage_path = storage_path
+        self._dirty = False
+        self._snapshot_task: Optional[asyncio.Task] = None
         # -- tables (reference: gcs_table_storage.h) ----------------------
         self.nodes: Dict[str, Dict[str, Any]] = {}       # node_id hex -> info
         self.actors: Dict[str, Dict[str, Any]] = {}      # actor_id hex -> info
@@ -53,13 +59,85 @@ class GcsServer:
         return self._rpc.address
 
     async def start(self) -> None:
+        self._load_storage()
         await self._rpc.start()
         self._health_task = asyncio.ensure_future(self._health_loop())
+        if self._storage_path:
+            self._snapshot_task = asyncio.ensure_future(
+                self._snapshot_loop())
         logger.info("GCS listening on %s", self.address)
+
+    # -- durable storage (reference: gcs_table_storage.h over a store
+    # client; here an atomic pickle snapshot, debounced at 1 Hz) --------
+    _PERSISTED_TABLES = ("actors", "named_actors", "jobs",
+                         "placement_groups", "kv")
+
+    def mark_dirty(self) -> None:
+        self._dirty = True
+
+    def _load_storage(self) -> None:
+        if not self._storage_path:
+            return
+        import os
+        import pickle
+
+        if not os.path.exists(self._storage_path):
+            return
+        try:
+            with open(self._storage_path, "rb") as f:
+                snap = pickle.load(f)
+        except Exception:
+            logger.warning("GCS storage at %s unreadable; starting fresh",
+                           self._storage_path, exc_info=True)
+            return
+        for table in self._PERSISTED_TABLES:
+            getattr(self, table).update(snap.get(table, {}))
+        # Recovered actor records point at pre-restart workers; their
+        # liveness is re-established by owners / health checks. Nodes are
+        # NOT persisted — raylets re-register via heartbeat.
+        logger.info("GCS recovered %d actors, %d jobs, %d kv keys from %s",
+                    len(self.actors), len(self.jobs), len(self.kv),
+                    self._storage_path)
+
+    async def _snapshot_loop(self) -> None:
+        while True:
+            await asyncio.sleep(1.0)
+            if not self._dirty:
+                continue
+            try:
+                await asyncio.to_thread(self._write_snapshot)
+                self._dirty = False
+            except Exception:
+                # Keep the dirty flag so the write retries next tick
+                # (e.g. transient ENOSPC) — an acked mutation must not be
+                # silently dropped.
+                logger.warning("GCS snapshot failed", exc_info=True)
+
+    def _write_snapshot(self) -> None:
+        import os
+        import pickle
+
+        snap = {table: dict(getattr(self, table))
+                for table in self._PERSISTED_TABLES}
+        tmp = f"{self._storage_path}.tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(snap, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._storage_path)
 
     async def stop(self) -> None:
         if self._health_task:
             self._health_task.cancel()
+        if self._snapshot_task:
+            self._snapshot_task.cancel()
+        if self._storage_path and self._dirty:
+            # Final flush: acked mutations survive a clean shutdown.
+            try:
+                self._write_snapshot()
+                self._dirty = False
+            except Exception:
+                logger.warning("final GCS snapshot failed", exc_info=True)
         await self._rpc.stop()
 
     # ------------------------------------------------------------------
@@ -183,6 +261,7 @@ class GcsServer:
     async def handle_register_actor(self, conn: ServerConnection, *,
                                     actor_id: str, info: Dict[str, Any]
                                     ) -> Dict[str, Any]:
+        self.mark_dirty()
         name = info.get("name")
         ns = info.get("namespace") or "default"
         if name:
@@ -204,6 +283,7 @@ class GcsServer:
     async def handle_update_actor(self, conn: ServerConnection, *,
                                   actor_id: str,
                                   updates: Dict[str, Any]) -> bool:
+        self.mark_dirty()
         info = self.actors.get(actor_id)
         if info is None:
             return False
@@ -236,6 +316,7 @@ class GcsServer:
     # ------------------------------------------------------------------
     async def handle_add_job(self, conn: ServerConnection, *, job_id: str,
                              info: Dict[str, Any]) -> bool:
+        self.mark_dirty()
         self.jobs[job_id] = dict(info, job_id=job_id,
                                  start_time=time.time())
         return True
@@ -246,9 +327,21 @@ class GcsServer:
 
     async def handle_mark_job_finished(self, conn: ServerConnection, *,
                                        job_id: str) -> bool:
+        self.mark_dirty()
         if job_id in self.jobs:
             self.jobs[job_id]["finished"] = True
             self.jobs[job_id]["end_time"] = time.time()
+        # Non-detached actors die with their job (reference:
+        # GcsActorManager::OnJobFinished); raylets subscribe and reap
+        # their local actor workers. Detached actors survive.
+        for actor_id, info in list(self.actors.items()):
+            if (info.get("job_id") == job_id
+                    and not info.get("detached")
+                    and info.get("state") not in ("DEAD",)):
+                info["state"] = "DEAD"
+                info["death_cause"] = "job finished"
+                await self._publish(f"actor:{actor_id}", info)
+        await self._publish("job", {"job_id": job_id, "finished": True})
         return True
 
     async def handle_list_jobs(self, conn: ServerConnection
@@ -276,6 +369,7 @@ class GcsServer:
     # ------------------------------------------------------------------
     async def handle_kv_put(self, conn: ServerConnection, *, key: bytes,
                             value: bytes, overwrite: bool = True) -> bool:
+        self.mark_dirty()
         k = key.decode() if isinstance(key, bytes) else key
         if not overwrite and k in self.kv:
             return False
@@ -289,6 +383,7 @@ class GcsServer:
 
     async def handle_kv_del(self, conn: ServerConnection, *,
                             key: bytes) -> bool:
+        self.mark_dirty()
         k = key.decode() if isinstance(key, bytes) else key
         return self.kv.pop(k, None) is not None
 
@@ -307,6 +402,7 @@ class GcsServer:
     async def handle_register_placement_group(
             self, conn: ServerConnection, *, pg_id: str,
             info: Dict[str, Any]) -> bool:
+        self.mark_dirty()
         self.placement_groups[pg_id] = dict(info, pg_id=pg_id)
         return True
 
@@ -314,6 +410,7 @@ class GcsServer:
             self, conn: ServerConnection, *, pg_id: str,
             updates: Dict[str, Any],
             expect_state: Optional[str] = None) -> bool:
+        self.mark_dirty()
         """`expect_state` makes the update conditional (CAS): the async
         owner-side scheduler must not resurrect a REMOVED group."""
         info = self.placement_groups.get(pg_id)
@@ -356,12 +453,17 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--storage", default=None,
+                        help="snapshot file for GCS fault tolerance; "
+                             "restart with the same path to recover "
+                             "tables")
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
 
     async def run():
-        server = GcsServer(args.host, args.port)
+        server = GcsServer(args.host, args.port,
+                           storage_path=args.storage)
         await server.start()
         print(f"GCS_ADDRESS={server.address}", flush=True)
         await asyncio.Event().wait()

@@ -118,7 +118,9 @@ class NodeSupervisor:
 
     def _start_gcs(self) -> None:
         self.gcs_address = self._spawn(
-            "gcs", [sys.executable, "-m", "ray_tpu.core.gcs.server"],
+            "gcs", [sys.executable, "-m", "ray_tpu.core.gcs.server",
+                    "--storage",
+                    os.path.join(self.session_dir, "gcs_storage.pkl")],
             r"GCS_ADDRESS=(\S+)")
 
     def _start_raylet(self, resources: Dict[str, float],

@@ -111,6 +111,24 @@ class LocalObjectStore:
             raise KeyError(f"object {oid[:8]} not present/sealed")
         return bytes(entry.shm.buf[: entry.size])
 
+    def read_range(self, oid: str, offset: int, length: int) -> bytes:
+        """One transfer chunk (reference: object_manager chunked reads,
+        object_manager.h default 1 MiB chunks)."""
+        entry = self._objects.get(oid)
+        if entry is None or not entry.sealed:
+            raise KeyError(f"object {oid[:8]} not present/sealed")
+        end = min(offset + length, entry.size)
+        return bytes(entry.shm.buf[offset:end])
+
+    def write_range(self, oid: str, offset: int, data: bytes) -> None:
+        """Fill part of a created-but-unsealed entry (chunked pull)."""
+        entry = self._objects.get(oid)
+        if entry is None:
+            raise KeyError(f"object {oid[:8]} was not created")
+        if entry.sealed:
+            return  # concurrent pull already completed it
+        entry.shm.buf[offset:offset + len(data)] = data
+
     def pin(self, oid: str, worker_id: str) -> None:
         entry = self._objects.get(oid)
         if entry is not None:

@@ -36,6 +36,8 @@ class _Worker:
         self.lease_id: Optional[str] = None
         self.ready = asyncio.Event()
         self.actor_id: Optional[str] = None
+        self.actor_job_id: Optional[str] = None
+        self.actor_detached = False
         self.held: Dict[str, float] = {}  # resources held by active lease
         self.bundle_key: Optional[str] = None  # PG bundle the lease drew from
         self.chip_ids: List[int] = []  # TPU chips granted to this lease
@@ -121,6 +123,7 @@ class Raylet:
             resources=self.resources_total, labels=self.labels,
             is_head=self.is_head)
         await self._gcs.subscribe("node", self._on_node_update)
+        await self._gcs.subscribe("job", self._on_job_update)
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         # Prestart a few workers so first-task latency is registration-bound,
         # not fork/exec-bound (reference: PrestartWorkers,
@@ -185,6 +188,21 @@ class Raylet:
     def _on_node_update(self, data) -> None:
         if not data.get("alive"):
             self._cluster_view.pop(data.get("node_id"), None)
+
+    def _on_job_update(self, data) -> None:
+        """Job finished: reap local non-detached actor workers of that
+        job (reference: GcsActorManager::OnJobFinished ->
+        KillActor on the owning node)."""
+        if not data.get("finished"):
+            return
+        job_id = data.get("job_id")
+        for worker in list(self._workers.values()):
+            if (worker.actor_id and worker.actor_job_id == job_id
+                    and not worker.actor_detached
+                    and worker.proc.poll() is None):
+                logger.info("reaping actor worker %s (job %s finished)",
+                            worker.worker_id[:8], (job_id or "")[:8])
+                worker.proc.terminate()
 
     # ------------------------------------------------------------------
     # worker pool (reference: worker_pool.h)
@@ -498,12 +516,16 @@ class Raylet:
     async def handle_mark_actor_worker(self, conn: ServerConnection, *,
                                        worker_id: str, actor_id: str,
                                        release: Optional[Dict[str, float]]
-                                       = None) -> bool:
+                                       = None,
+                                       job_id: Optional[str] = None,
+                                       detached: bool = False) -> bool:
         """Record the actor on its worker; `release` downgrades the lease to
         the actor's running demand (placement CPU released after __init__)."""
         worker = self._workers.get(worker_id)
         if worker is not None:
             worker.actor_id = actor_id
+            worker.actor_job_id = job_id
+            worker.actor_detached = detached
             if release:
                 b = (self._bundles.get(worker.bundle_key)
                      if worker.bundle_key else None)
@@ -605,10 +627,68 @@ class Raylet:
 
     async def handle_read_object(self, conn: ServerConnection, *,
                                  oid: str) -> Optional[bytes]:
-        """Remote raylet pull (data-plane; single frame)."""
+        """Remote raylet pull (data-plane; single frame, small objects)."""
         if not self.store.contains(oid):
             return None
         return self.store.read_bytes(oid)
+
+    async def handle_object_meta(self, conn: ServerConnection, *,
+                                 oid: str) -> Optional[Dict[str, int]]:
+        info = self.store.info(oid)
+        if info is None:
+            return None
+        return {"size": info[1]}
+
+    async def handle_read_object_chunk(self, conn: ServerConnection, *,
+                                       oid: str, offset: int,
+                                       length: int) -> Optional[bytes]:
+        """One chunk of a large object (reference: object_manager.h
+        chunked transfer). Returns None if the object vanished."""
+        if not self.store.contains(oid):
+            return None
+        return self.store.read_range(oid, offset, length)
+
+    # Large objects stream in 1 MiB frames so a multi-GB transfer neither
+    # doubles peak memory nor monopolizes either event loop.
+    TRANSFER_CHUNK = 1 << 20
+
+    async def _pull_from_holder(self, remote, oid: str) -> bool:
+        """Copy `oid` from a remote raylet into the local store. Returns
+        False if the holder no longer has it."""
+        meta = await remote.call("object_meta", oid=oid, timeout=30.0)
+        if meta is None:
+            return False
+        size = meta["size"]
+        if size <= self.TRANSFER_CHUNK:
+            data = await remote.call("read_object", oid=oid, timeout=60.0)
+            if data is None:
+                return False
+            self.store.put_bytes(oid, data)
+            return True
+        if self.store.contains(oid):
+            return True
+        try:
+            self.store.create(oid, size)
+        except FileExistsError:
+            # A concurrent pull sealed it between contains() and here.
+            return self.store.contains(oid)
+        try:
+            for offset in range(0, size, self.TRANSFER_CHUNK):
+                chunk = await remote.call(
+                    "read_object_chunk", oid=oid, offset=offset,
+                    length=self.TRANSFER_CHUNK, timeout=60.0)
+                if chunk is None:
+                    raise KeyError(f"{oid[:8]} evicted mid-transfer")
+                self.store.write_range(oid, offset, chunk)
+            self.store.seal(oid)
+        except BaseException:
+            # Only roll back an entry WE still own unsealed — a
+            # concurrent pull may have sealed it and handed readers the
+            # mapping (contains() == sealed).
+            if not self.store.contains(oid):
+                self.store.delete(oid)
+            raise
+        return True
 
     async def handle_put_object(self, conn: ServerConnection, *,
                                 oid: str, data: bytes) -> bool:
@@ -669,8 +749,7 @@ class Raylet:
                         continue
                     try:
                         remote = await self._raylet_client(node_addr)
-                        data = await remote.call("read_object", oid=oid,
-                                                 timeout=60.0)
+                        fetched = await self._pull_from_holder(remote, oid)
                     except Exception:
                         # Unreachable holder: if the cluster has declared
                         # its node dead, prune the location so the owner
@@ -683,8 +762,7 @@ class Raylet:
                             except Exception:
                                 pass
                         continue
-                    if data is not None:
-                        self.store.put_bytes(oid, data)
+                    if fetched:
                         info = self.store.info(oid)
                         return {"shm_name": info[0], "size": info[1]}
                     # The node answered but no longer holds the object

@@ -1,0 +1,111 @@
+"""ActorPool: fixed set of actors consuming a stream of work.
+
+Reference equivalent: `python/ray/util/actor_pool.py` — same surface
+(`map`, `map_unordered`, `submit`, `get_next`, `get_next_unordered`,
+`has_next`, `push`, `pop_idle`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List, TypeVar
+
+V = TypeVar("V")
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle: List[Any] = list(actors)
+        if not self._idle:
+            raise ValueError("ActorPool needs at least one actor")
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List[tuple] = []
+
+    # -- core ----------------------------------------------------------
+    def submit(self, fn: Callable[[Any, V], Any], value: V) -> None:
+        """fn(actor, value) -> ObjectRef; queued if no actor is idle."""
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = actor
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future)
+
+    def get_next(self, timeout: float = None) -> Any:
+        """Next result in submission order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        if self._next_return_index not in self._index_to_future:
+            # Earlier indices were consumed by get_next_unordered: the
+            # "next in order" is the smallest remaining submission index.
+            self._next_return_index = min(self._index_to_future)
+        future = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        import ray_tpu
+
+        try:
+            return ray_tpu.get(future, timeout=timeout)
+        finally:
+            self._return_actor(self._future_to_actor.pop(future))
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        """Next result in completion order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        import ray_tpu
+
+        ready, _ = ray_tpu.wait(
+            list(self._future_to_actor), num_returns=1,
+            timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        future = ready[0]
+        for idx, f in list(self._index_to_future.items()):
+            if f == future:
+                del self._index_to_future[idx]
+                break
+        try:
+            return ray_tpu.get(future, timeout=timeout)
+        finally:
+            self._return_actor(self._future_to_actor.pop(future))
+
+    def _return_actor(self, actor) -> None:
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            future = fn(actor, value)
+            self._future_to_actor[future] = actor
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._idle.append(actor)
+
+    # -- map frontends ---------------------------------------------------
+    def map(self, fn: Callable[[Any, V], Any],
+            values: Iterable[V]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, V], Any],
+                      values: Iterable[V]) -> Iterator[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # -- membership ------------------------------------------------------
+    def push(self, actor) -> None:
+        self._idle.append(actor)
+        if self._pending_submits:
+            self._return_actor(self._idle.pop())
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None

@@ -1,0 +1,142 @@
+"""ray_tpu.cancel(): queued-task drop, running-task interrupt, force kill.
+
+Reference coverage class: `python/ray/tests/test_cancel.py` —
+cancellation semantics: queued tasks never run, running tasks get
+TaskCancelledError raised at the next Python bytecode boundary,
+force=True kills the executing worker, and cancelled tasks are not
+retried.
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.exceptions import TaskCancelledError
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _interruptible_sleep(seconds):
+    # PyThreadState_SetAsyncExc lands at bytecode boundaries: sleep in
+    # small slices so cancellation interrupts promptly.
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        time.sleep(0.01)
+    return "finished"
+
+
+def test_cancel_running_task(ray_cluster):
+    ray_tpu = ray_cluster
+    f = ray_tpu.remote(_interruptible_sleep)
+    ref = f.remote(60)
+    time.sleep(1.0)  # let it start
+    t0 = time.monotonic()
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.monotonic() - t0 < 20
+
+
+def test_cancel_queued_task_never_runs(ray_cluster):
+    ray_tpu = ray_cluster
+    marker = []
+
+    f = ray_tpu.remote(_interruptible_sleep)
+    # Fill both CPUs, then queue one more.
+    busy = [f.remote(4) for _ in range(2)]
+    queued = f.remote(60)
+    time.sleep(0.3)
+    ray_tpu.cancel(queued)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued, timeout=60)
+    # The busy tasks finish normally.
+    assert ray_tpu.get(busy, timeout=60) == ["finished", "finished"]
+    del marker
+
+
+def test_force_cancel_kills_worker_without_retry(ray_cluster):
+    ray_tpu = ray_cluster
+    f = ray_tpu.remote(_interruptible_sleep)
+    # max_retries would normally re-run a crashed task; cancellation must
+    # override that.
+    ref = f.options(max_retries=2).remote(60)
+    time.sleep(1.0)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_cancel_async_actor_method(ray_cluster):
+    """An async method parked on the actor's event loop cancels through
+    its coroutine, not the blocked executor thread."""
+    import asyncio
+
+    ray_tpu = ray_cluster
+
+    class Waiter:
+        async def wait_forever(self):
+            await asyncio.sleep(3600)
+
+        def ping(self):
+            return "pong"
+
+    w = ray_tpu.remote(max_concurrency=4)(Waiter).remote()
+    assert ray_tpu.get(w.ping.remote(), timeout=60) == "pong"
+    ref = w.wait_forever.remote()
+    time.sleep(1.0)
+    t0 = time.monotonic()
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.monotonic() - t0 < 15
+    assert ray_tpu.get(w.ping.remote(), timeout=60) == "pong"
+    ray_tpu.kill(w)
+
+
+def test_force_cancel_actor_task_rejected(ray_cluster):
+    ray_tpu = ray_cluster
+
+    class Slow2:
+        def run(self):
+            return _interruptible_sleep(30)
+
+    a = ray_tpu.remote(Slow2).remote()
+    ref = a.run.remote()
+    time.sleep(1.0)
+    with pytest.raises(ValueError, match="force"):
+        ray_tpu.cancel(ref, force=True)
+    ray_tpu.cancel(ref)  # non-force works
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    ray_tpu.kill(a)
+
+
+def test_cancel_actor_task(ray_cluster):
+    ray_tpu = ray_cluster
+
+    class Slow:
+        def run(self, seconds):
+            return _interruptible_sleep(seconds)
+
+        def ping(self):
+            return "pong"
+
+    a = ray_tpu.remote(Slow).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    ref = a.run.remote(60)
+    time.sleep(1.0)
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    # The actor itself survives a (non-force) task cancel.
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    ray_tpu.kill(a)

@@ -1,0 +1,99 @@
+"""Detached actor lifetime vs job-scoped actors.
+
+Reference coverage class: `python/ray/tests/test_actor_lifetime.py` —
+lifetime="detached" actors survive their creating driver; default actors
+die when their job finishes (GcsActorManager::OnJobFinished).
+"""
+
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def shared_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 4})
+    yield cluster
+    cluster.shutdown()
+
+
+_DRIVER_A = textwrap.dedent("""
+    import ray_tpu
+
+    ray_tpu.init(address={address!r})
+
+    class Counter:
+        def __init__(self):
+            self.n = 0
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    C = ray_tpu.remote(num_cpus=0)(Counter)
+    d = C.options(name="survivor", lifetime="detached").remote()
+    e = C.options(name="ephemeral").remote()
+    assert ray_tpu.get(d.inc.remote(), timeout=60) == 1
+    assert ray_tpu.get(e.inc.remote(), timeout=60) == 1
+    print("DRIVER_A_OK", flush=True)
+    ray_tpu.shutdown()
+""")
+
+
+def test_detached_survives_driver_exit(shared_cluster):
+    import ray_tpu
+
+    script = _DRIVER_A.format(address=shared_cluster.address)
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=180)
+    assert "DRIVER_A_OK" in proc.stdout, proc.stderr[-2000:]
+
+    ray_tpu.init(address=shared_cluster.address,
+                 ignore_reinit_error=True)
+    try:
+        # Detached actor is alive and kept its state.
+        d = ray_tpu.get_actor("survivor")
+        assert ray_tpu.get(d.inc.remote(), timeout=60) == 2
+
+        # The job-scoped actor was reaped when driver A's job finished.
+        deadline = time.monotonic() + 30
+        ephemeral_dead = False
+        while time.monotonic() < deadline:
+            try:
+                e = ray_tpu.get_actor("ephemeral")
+                ray_tpu.get(e.inc.remote(), timeout=5)
+            except Exception:
+                ephemeral_dead = True
+                break
+            time.sleep(0.5)
+        assert ephemeral_dead, "job-scoped actor outlived its driver"
+
+        # Explicit kill ends the detached actor.
+        ray_tpu.kill(d)
+        time.sleep(1.0)
+        with pytest.raises(Exception):
+            ray_tpu.get(d.inc.remote(), timeout=10)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_detached_requires_name(shared_cluster):
+    import ray_tpu
+
+    ray_tpu.init(address=shared_cluster.address,
+                 ignore_reinit_error=True)
+    try:
+        class A:
+            pass
+
+        with pytest.raises(ValueError, match="named"):
+            ray_tpu.remote(A).options(lifetime="detached").remote()
+    finally:
+        ray_tpu.shutdown()

@@ -1,0 +1,157 @@
+"""ActorPool, Queue, batched wait, GCS persistence.
+
+Reference coverage class: `python/ray/tests/test_actor_pool.py`,
+`test_queue.py`, `test_wait.py`, and the GCS FT tests
+(`test_gcs_fault_tolerance.py` — here: snapshot/recover).
+"""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class _Sq:
+    def compute(self, x):
+        time.sleep(0.01 * (x % 3))
+        return x * x
+
+
+def test_actor_pool_map_ordered(ray_cluster):
+    from ray_tpu.util.actor_pool import ActorPool
+
+    ray_tpu = ray_cluster
+    actors = [ray_tpu.remote(num_cpus=0)(_Sq).remote() for _ in range(2)]
+    pool = ActorPool(actors)
+    out = list(pool.map(lambda a, v: a.compute.remote(v), range(8)))
+    assert out == [v * v for v in range(8)]
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_actor_pool_unordered_and_requeue(ray_cluster):
+    from ray_tpu.util.actor_pool import ActorPool
+
+    ray_tpu = ray_cluster
+    actors = [ray_tpu.remote(num_cpus=0)(_Sq).remote() for _ in range(2)]
+    pool = ActorPool(actors)
+    out = sorted(pool.map_unordered(
+        lambda a, v: a.compute.remote(v), range(8)))
+    assert out == sorted(v * v for v in range(8))
+    # More submits than actors exercises the pending-queue path.
+    for v in range(5):
+        pool.submit(lambda a, v: a.compute.remote(v), v)
+    got = sorted(pool.get_next() for _ in range(5))
+    assert got == [0, 1, 4, 9, 16]
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_queue_fifo_and_timeout(ray_cluster):
+    from ray_tpu.util.queue import Empty, Queue
+
+    q = Queue(maxsize=4)
+    for i in range(3):
+        q.put(i)
+    assert q.qsize() == 3
+    assert [q.get() for _ in range(3)] == [0, 1, 2]
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get(block=False)
+    t0 = time.monotonic()
+    with pytest.raises(Empty):
+        q.get(timeout=0.3)
+    assert time.monotonic() - t0 >= 0.25
+    q.shutdown()
+
+
+def test_queue_maxsize_full(ray_cluster):
+    from ray_tpu.util.queue import Full, Queue
+
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.full()
+    assert q.get() == 1
+    q.put(3)
+    q.shutdown()
+
+
+def test_wait_batched_many_refs(ray_cluster):
+    """wait() over many refs must stay cheap (owned refs resolve on local
+    futures, no RPC storm) and honor num_returns."""
+    ray_tpu = ray_cluster
+
+    def slow(i):
+        time.sleep(0.05 + 0.01 * (i % 5))
+        return i
+
+    f = ray_tpu.remote(slow)
+    refs = [f.remote(i) for i in range(40)]
+    t0 = time.monotonic()
+    ready, pending = ray_tpu.wait(refs, num_returns=5, timeout=60)
+    assert len(ready) >= 5
+    assert len(ready) + len(pending) == 40
+    ready_all, pending_all = ray_tpu.wait(refs, num_returns=40,
+                                          timeout=120)
+    assert len(ready_all) == 40 and not pending_all
+    assert time.monotonic() - t0 < 60
+
+
+_GCS_FT_SCRIPT = """
+import asyncio, sys
+from ray_tpu.core.gcs.server import GcsServer
+
+async def run(phase, path):
+    server = GcsServer(port=0, storage_path=path)
+    await server.start()
+    if phase == "write":
+        from types import SimpleNamespace
+        conn = None
+        await server.handle_kv_put(conn, key=b"k1", value=b"v1",
+                                   overwrite=True)
+        await server.handle_add_job(conn, job_id="jobA",
+                                    info={"driver": "x"})
+        await server.handle_register_actor(conn, actor_id="a1",
+            info={"name": "det", "namespace": "default",
+                  "state": "ALIVE", "detached": True})
+        await asyncio.sleep(2.5)  # > snapshot debounce
+        print("WROTE", flush=True)
+    else:
+        v = await server.handle_kv_get(None, key=b"k1")
+        job = await server.handle_get_job(None, job_id="jobA")
+        actor = await server.handle_get_actor(None, actor_id="a1")
+        assert v == b"v1", v
+        assert job and job["driver"] == "x"
+        assert actor and actor["name"] == "det"
+        print("RECOVERED", flush=True)
+    await server.stop()
+
+asyncio.run(run(sys.argv[1], sys.argv[2]))
+"""
+
+
+def test_gcs_snapshot_recovery(tmp_path):
+    path = str(tmp_path / "gcs.pkl")
+    w = subprocess.run([sys.executable, "-c", _GCS_FT_SCRIPT, "write",
+                        path], capture_output=True, text=True,
+                       timeout=120)
+    assert "WROTE" in w.stdout, w.stderr[-2000:]
+    r = subprocess.run([sys.executable, "-c", _GCS_FT_SCRIPT, "read",
+                        path], capture_output=True, text=True,
+                       timeout=120)
+    assert "RECOVERED" in r.stdout, r.stderr[-2000:]
