@@ -1,0 +1,20 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+Reference equivalent: `python/ray/autoscaler/` (v2: `autoscaler/v2/`
+instance manager + scheduler). The monitor polls the GCS for aggregate
+pending demand + node load, asks a NodeProvider for more capacity when
+demand is unmet for `upscale_delay_s`, and releases idle nodes after
+`idle_timeout_s`. Providers are pluggable; `LocalNodeProvider` spawns
+raylet processes on this host (the test/demo provider, like the
+reference's fake multinode provider).
+"""
+
+from ray_tpu.autoscaler.autoscaler import (Autoscaler, AutoscalerConfig,
+                                           StandardAutoscaler)
+from ray_tpu.autoscaler.node_provider import (LocalNodeProvider,
+                                              NodeProvider)
+
+__all__ = [
+    "Autoscaler", "StandardAutoscaler", "AutoscalerConfig",
+    "NodeProvider", "LocalNodeProvider",
+]

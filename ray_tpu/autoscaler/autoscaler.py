@@ -1,0 +1,204 @@
+"""StandardAutoscaler: reconcile cluster size to resource demand.
+
+Reference equivalent: `python/ray/autoscaler/_private/autoscaler.py`
+(`StandardAutoscaler.update`, bin-packing in `resource_demand_scheduler.py`)
+and the v2 instance-manager loop. Each tick:
+
+1. read node table + per-raylet load (pending lease demands) from GCS,
+2. bin-pack unmet demands onto launchable node types,
+3. launch what's missing (after `upscale_delay_s` of sustained demand),
+4. terminate provider nodes idle longer than `idle_timeout_s`,
+honoring each type's min/max and the cluster-wide max.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider, NodeType
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: List[NodeType] = field(default_factory=list)
+    max_workers: int = 8
+    upscale_delay_s: float = 1.0
+    idle_timeout_s: float = 30.0
+    tick_interval_s: float = 1.0
+
+
+class StandardAutoscaler:
+    def __init__(self, gcs_address: str, provider: NodeProvider,
+                 config: AutoscalerConfig):
+        from ray_tpu.core.gcs.client import GcsClient
+        from ray_tpu.core.rpc import EventLoopThread
+
+        self.provider = provider
+        self.config = config
+        self._loop = EventLoopThread(name="autoscaler")
+        self._gcs = GcsClient(gcs_address)
+        self._loop.run(self._gcs.connect())
+        self._demand_since: Optional[float] = None
+        self._idle_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.launched: Dict[str, str] = {}   # node_id -> type name
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        # Satisfy min_workers immediately.
+        for nt in self.config.node_types:
+            for _ in range(nt.min_workers):
+                self._launch(nt)
+        while not self._stop.wait(self.config.tick_interval_s):
+            try:
+                self.update()
+            except Exception:
+                logger.warning("autoscaler tick failed", exc_info=True)
+
+    # -- one reconcile tick ---------------------------------------------
+    def update(self) -> None:
+        nodes = self._loop.run(self._gcs.get_nodes(), timeout=10)
+        alive = [n for n in nodes if n.get("alive")]
+        demands = self._unmet_demands(alive)
+        if demands:
+            self._idle_since.clear()
+            if self._demand_since is None:
+                self._demand_since = time.monotonic()
+            elif (time.monotonic() - self._demand_since
+                  >= self.config.upscale_delay_s):
+                self._scale_up(demands)
+        else:
+            self._demand_since = None
+            self._reap_idle(alive)
+
+    def _unmet_demands(self, alive: List[dict]) -> List[Dict[str, float]]:
+        """Pending lease demands no alive node can satisfy right now
+        (reference: load metrics' pending resource shapes)."""
+        demands: List[Dict[str, float]] = []
+        for n in alive:
+            load = n.get("load") or {}
+            shapes = load.get("pending_demands")
+            if shapes is None and load.get("pending"):
+                shapes = [{"CPU": 1.0}] * int(load["pending"])
+            demands.extend(shapes or [])
+        if not demands:
+            return []
+        free = [dict(n.get("resources_available", {})) for n in alive]
+        unmet = []
+        for demand in demands:
+            placed = False
+            for avail in free:
+                if all(avail.get(k, 0.0) + 1e-9 >= v
+                       for k, v in demand.items()):
+                    for k, v in demand.items():
+                        avail[k] = avail.get(k, 0.0) - v
+                    placed = True
+                    break
+            if not placed:
+                unmet.append(demand)
+        return unmet
+
+    def _scale_up(self, unmet: List[Dict[str, float]]) -> None:
+        current = len(self.provider.non_terminated_nodes())
+        # Bin-pack unmet demands onto new nodes, cheapest-first
+        # (reference: get_nodes_for in resource_demand_scheduler.py).
+        to_launch: List[NodeType] = []
+        remaining = [dict(d) for d in unmet]
+        while remaining and current + len(to_launch) \
+                < self.config.max_workers:
+            nt = self._pick_type(remaining[0])
+            if nt is None:
+                logger.warning("no node type fits demand %s",
+                               remaining[0])
+                remaining.pop(0)
+                continue
+            cap = dict(nt.resources)
+            fitted = []
+            for demand in remaining:
+                if all(cap.get(k, 0.0) + 1e-9 >= v
+                       for k, v in demand.items()):
+                    for k, v in demand.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    fitted.append(demand)
+            for demand in fitted:
+                remaining.remove(demand)
+            to_launch.append(nt)
+        for nt in to_launch:
+            self._launch(nt)
+
+    def _pick_type(self, demand: Dict[str, float]) -> Optional[NodeType]:
+        for nt in self.config.node_types:
+            count = sum(1 for t in self.launched.values()
+                        if t == nt.name)
+            if count >= nt.max_workers:
+                continue
+            if all(nt.resources.get(k, 0.0) >= v
+                   for k, v in demand.items()):
+                return nt
+        return None
+
+    def _launch(self, nt: NodeType) -> None:
+        node_id = self.provider.create_node(nt)
+        self.launched[node_id] = nt.name
+        logger.info("autoscaler launched %s node %s", nt.name,
+                    node_id[:8])
+
+    def _reap_idle(self, alive: List[dict]) -> None:
+        now = time.monotonic()
+        by_id = {n["node_id"]: n for n in alive}
+        for node_id in self.provider.non_terminated_nodes():
+            info = by_id.get(node_id)
+            nt_name = self.launched.get(node_id)
+            nt = next((t for t in self.config.node_types
+                       if t.name == nt_name), None)
+            floor = nt.min_workers if nt else 0
+            same_type = sum(
+                1 for nid in self.provider.non_terminated_nodes()
+                if self.launched.get(nid) == nt_name)
+            if same_type <= floor:
+                self._idle_since.pop(node_id, None)
+                continue
+            busy = False
+            if info is not None:
+                total = info.get("resources_total", {}) or info.get(
+                    "Resources", {})
+                avail = info.get("resources_available", {})
+                busy = any(avail.get(k, 0.0) + 1e-9 < v
+                           for k, v in total.items()
+                           if k in ("CPU", "TPU"))
+                busy = busy or bool((info.get("load") or {}).get(
+                    "pending"))
+            if busy:
+                self._idle_since.pop(node_id, None)
+                continue
+            first = self._idle_since.setdefault(node_id, now)
+            if now - first >= self.config.idle_timeout_s:
+                logger.info("autoscaler terminating idle node %s",
+                            node_id[:8])
+                self.provider.terminate_node(node_id)
+                self.launched.pop(node_id, None)
+                self._idle_since.pop(node_id, None)
+
+    def shutdown(self) -> None:
+        self.stop()
+        for node_id in list(self.provider.non_terminated_nodes()):
+            self.provider.terminate_node(node_id)
+
+
+Autoscaler = StandardAutoscaler

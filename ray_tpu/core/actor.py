@@ -156,6 +156,8 @@ class ActorClass:
                 continue
             meta[name] = {
                 "num_returns": getattr(member, "_num_returns", 1),
+                "concurrency_group": getattr(member,
+                                             "_concurrency_group", None),
                 "is_async": (inspect.iscoroutinefunction(member)
                              or inspect.isasyncgenfunction(member)),
                 "is_generator": inspect.isgeneratorfunction(member)

@@ -89,6 +89,15 @@ class _ActorState:
         self.pinned_args: List[ObjectID] = []  # ctor-arg refs, pinned until DEAD
 
 
+def _prepared_env(rt, opts):
+    env = getattr(opts, "runtime_env", None)
+    if not env:
+        return None
+    from ray_tpu.core.runtime_env import prepare_spec_env
+
+    return prepare_spec_env(rt, env)
+
+
 class _TaskCancelledBeforePush(Exception):
     """Internal: cancel() landed while the task was queued for a lease."""
 
@@ -171,6 +180,7 @@ class ClusterRuntime:
         self._actor_instance: Any = None
         self._actor_executor: Optional[
             concurrent.futures.ThreadPoolExecutor] = None
+        self._actor_group_executors: Dict[str, Any] = {}
         self._actor_loop = None
         self._actor_id_hex: Optional[str] = None
         self._shutdown = False
@@ -518,6 +528,12 @@ class ClusterRuntime:
                 break
             time.sleep(tick)
             tick = min(tick * 2, 0.05)  # back off toward 50 ms
+        # Reference contract: ready holds at most num_returns; anything
+        # extra that completed in the same scan stays in pending.
+        if len(ready) > num_returns:
+            extra = ready[num_returns:]
+            ready = ready[:num_returns]
+            pending = extra + pending
         return ready, pending
 
     # ==================================================================
@@ -543,6 +559,9 @@ class ClusterRuntime:
             "resources": resource_demand(opts),
             "max_retries": opts.max_retries,
         }
+        env = _prepared_env(self, opts)
+        if env:
+            spec["runtime_env"] = env
         pg = _pg_id_of(getattr(opts, "placement_group", None))
         if pg is not None:
             spec["pg"] = {
@@ -672,9 +691,13 @@ class ClusterRuntime:
 
     async def _run_on_leased_worker(self, spec: dict) -> None:
         pg = spec.get("pg")
+        from ray_tpu.core.runtime_env import env_hash
+
         key = (f"{spec['fn_key']}:{sorted(spec['resources'].items())}"
                f":{pg['pg_id']}:{pg['bundle_index']}" if pg else
                f"{spec['fn_key']}:{sorted(spec['resources'].items())}")
+        # Distinct runtime envs never share a leased worker.
+        key += f":{env_hash(spec.get('runtime_env'))}"
         worker = await self._acquire_worker(key, spec["resources"], pg=pg)
         if spec["task_id"] in self._cancel_requested:
             # Cancelled while queued for a lease: never push.
@@ -907,6 +930,8 @@ class ClusterRuntime:
             "demand": demand,
             "release_after_start": {} if running_demand else demand,
             "max_concurrency": opts.max_concurrency,
+            "concurrency_groups": opts.concurrency_groups,
+            "runtime_env": _prepared_env(self, opts),
             "class_name": actor_class._class_name,
             "pg": ({"pg_id": _pg_id_of(opts.placement_group),
                     "bundle_index": getattr(
@@ -953,6 +978,8 @@ class ClusterRuntime:
                 max_concurrency=creation["max_concurrency"],
                 owner=self.address, job_id=self.job_id.hex(),
                 visible_chips=worker.get("chip_ids") or None,
+                concurrency_groups=creation.get("concurrency_groups"),
+                runtime_env=creation.get("runtime_env"),
                 timeout=120.0)
         except Exception as e:
             await self._return_worker(worker, dead=True)
@@ -1002,6 +1029,8 @@ class ClusterRuntime:
             "streaming": streaming,
             "owner": self.address,
             "seq": seq,
+            "concurrency_group": (handle._method_meta or {}).get(
+                method_name, {}).get("concurrency_group"),
         }
         refs = self._make_return_refs(task_id, num_returns)
         self._record_task_event(task_id.hex(), spec["name"], "SUBMITTED",
@@ -1051,8 +1080,17 @@ class ClusterRuntime:
         aid = spec["actor_id"]
         try:
             if spec["task_id"] in self._cancel_requested:
-                # Cancelled before the push left this process.
+                # Cancelled before the push left this process: resolve the
+                # refs AND tell the worker to skip this seq so the next
+                # call doesn't stall behind the hole.
                 self._fail_task_cancelled(spec, refs)
+                try:
+                    client = await self._actor_client(aid)
+                    await client.notify("actor_seq_skip",
+                                        owner=self.address,
+                                        seq=spec.get("seq"))
+                except Exception:
+                    pass  # 60s gate timeout is the backstop
                 return
             client = await self._actor_client(aid)
             state = self._actors.get(aid)
@@ -1194,6 +1232,13 @@ class ClusterRuntime:
         tasks get TaskCancelledError raised in their thread; force=True
         kills the executing worker process)."""
         task_hex = ref.id().task_id().hex()
+        with self._owned_lock:
+            entry = self._owned.get(ref.hex())
+        if entry is not None and entry.fut.done():
+            # Already finished: cancel is a no-op (reference semantics) —
+            # and must not leave a flag that would poison a later lineage
+            # re-execution of this same task id.
+            return
         inflight = self._inflight_task_workers.get(task_hex)
         if inflight is not None and inflight[1] and force:
             # Reference parity: force-killing an actor task would kill
@@ -1616,6 +1661,10 @@ class ClusterRuntime:
                 raise TaskCancelledError(task_id)
             self._apply_visible_chips(spec.get("visible_chips"))
             self._ensure_job_env(spec.get("job_id"))
+            if spec.get("runtime_env"):
+                from ray_tpu.core.runtime_env import apply_runtime_env
+
+                apply_runtime_env(self, spec["runtime_env"])
             fn = self._fn.fetch(spec["fn_key"])
             args, kwargs = self._resolve_task_args(spec["args"])
             value = fn(*args, **kwargs)
@@ -1737,7 +1786,10 @@ class ClusterRuntime:
                                 max_concurrency: Optional[int],
                                 owner: str,
                                 job_id: Optional[str] = None,
-                                visible_chips=None) -> dict:
+                                visible_chips=None,
+                                concurrency_groups: Optional[dict] = None,
+                                runtime_env: Optional[dict] = None
+                                ) -> dict:
         import asyncio
         import inspect as _inspect
 
@@ -1747,6 +1799,10 @@ class ClusterRuntime:
             try:
                 self._apply_visible_chips(visible_chips)
                 self._ensure_job_env(job_id)
+                if runtime_env:
+                    from ray_tpu.core.runtime_env import apply_runtime_env
+
+                    apply_runtime_env(self, runtime_env)
                 cls = self._fn.fetch(cls_key)
                 rargs, rkwargs = self._resolve_task_args(args)
                 self._actor_instance = cls(*rargs, **rkwargs)
@@ -1758,6 +1814,15 @@ class ClusterRuntime:
                 self._actor_executor = (
                     concurrent.futures.ThreadPoolExecutor(
                         max_workers=conc, thread_name_prefix="actor-exec"))
+                # Concurrency groups: each group gets its own bounded
+                # executor; ungrouped methods share the default one
+                # (reference: concurrency_group_manager.h).
+                self._actor_group_executors = {
+                    name: concurrent.futures.ThreadPoolExecutor(
+                        max_workers=limit,
+                        thread_name_prefix=f"actor-{name}")
+                    for name, limit in (concurrency_groups or {}).items()
+                }
                 if is_async:
                     import asyncio as aio
                     self._actor_loop = aio.new_event_loop()
@@ -1840,8 +1905,10 @@ class ClusterRuntime:
             return await self._execute_streaming(spec, actor=True)
         loop = asyncio.get_running_loop()
         await self._await_actor_turn(spec)
+        executor = (getattr(self, "_actor_group_executors", {}) or {}).get(
+            spec.get("concurrency_group"))
         fut = loop.run_in_executor(
-            self._actor_executor or self._exec_pool,
+            executor or self._actor_executor or self._exec_pool,
             self._execute_actor_method, spec)
         self._advance_actor_turn(spec)
         return await fut
@@ -1861,9 +1928,23 @@ class ClusterRuntime:
                 for key, e in list(self._actor_seq.items()):
                     if not e["cond"]._waiters:
                         del self._actor_seq[key]
-            entry = {"next": None, "cond": asyncio.Condition()}
+            entry = {"next": None, "cond": asyncio.Condition(),
+                     "skipped": set()}
             self._actor_seq[caller] = entry
         return entry
+
+    async def handle_actor_seq_skip(self, conn: ServerConnection, *,
+                                    owner: str,
+                                    seq: Optional[int] = None) -> bool:
+        """A seq consumed caller-side will never be pushed (cancelled
+        pre-push): release successors immediately."""
+        if seq is None:
+            return True
+        entry = self._actor_seq_entry(owner)
+        async with entry["cond"]:
+            entry["skipped"].add(seq)
+            entry["cond"].notify_all()
+        return True
 
     async def _await_actor_turn(self, spec: dict) -> None:
         seq = spec.get("seq")
@@ -1876,6 +1957,11 @@ class ClusterRuntime:
                 # caller reconnected after a restart): adopt its seq.
                 entry["next"] = seq
             while entry["next"] < seq:
+                if entry["next"] in entry["skipped"]:
+                    # Explicitly-skipped hole (cancelled pre-push).
+                    entry["skipped"].discard(entry["next"])
+                    entry["next"] += 1
+                    continue
                 try:
                     await asyncio.wait_for(entry["cond"].wait(),
                                            timeout=60.0)

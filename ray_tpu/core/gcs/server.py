@@ -104,22 +104,28 @@ class GcsServer:
             await asyncio.sleep(1.0)
             if not self._dirty:
                 continue
+            # Clear BEFORE the write: a mutation acked mid-write re-sets
+            # the flag and gets the next snapshot; clearing after would
+            # drop it. On failure re-set so the write retries (transient
+            # ENOSPC must not lose acked mutations).
+            self._dirty = False
             try:
                 await asyncio.to_thread(self._write_snapshot)
-                self._dirty = False
             except Exception:
-                # Keep the dirty flag so the write retries next tick
-                # (e.g. transient ENOSPC) — an acked mutation must not be
-                # silently dropped.
+                self._dirty = True
                 logger.warning("GCS snapshot failed", exc_info=True)
 
     def _write_snapshot(self) -> None:
         import os
         import pickle
+        import threading
 
         snap = {table: dict(getattr(self, table))
                 for table in self._PERSISTED_TABLES}
-        tmp = f"{self._storage_path}.tmp"
+        # Unique tmp per writer: stop()'s final flush may overlap an
+        # in-flight to_thread write; each renames atomically.
+        tmp = (f"{self._storage_path}.tmp.{os.getpid()}"
+               f".{threading.get_ident()}")
         with open(tmp, "wb") as f:
             pickle.dump(snap, f)
             f.flush()

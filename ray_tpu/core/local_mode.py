@@ -254,6 +254,11 @@ class LocalModeRuntime:
                 break
             if not progressed:
                 time.sleep(0.001)
+        # Reference contract: at most num_returns in ready.
+        if len(ready) > num_returns:
+            extra = ready[num_returns:]
+            ready = ready[:num_returns]
+            pending = extra + pending
         return ready, pending
 
     # -- tasks -----------------------------------------------------------

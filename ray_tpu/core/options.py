@@ -85,10 +85,14 @@ def _validate(updates: Dict[str, Any], *, for_actor: bool) -> None:
     if nr is not None and not (
             isinstance(nr, int) and nr >= 0) and nr not in ("streaming", "dynamic"):
         raise ValueError(f"num_returns must be int>=0 or 'streaming'/'dynamic', got {nr!r}")
-    # Explicitly unimplemented rather than silently ignored.
-    if updates.get("concurrency_groups"):
-        raise NotImplementedError(
-            "concurrency_groups are not supported yet; use max_concurrency")
+    groups = updates.get("concurrency_groups")
+    if groups:
+        if not isinstance(groups, dict) or not all(
+                isinstance(k, str) and isinstance(v, int) and v > 0
+                for k, v in groups.items()):
+            raise ValueError(
+                "concurrency_groups must be {group_name: max_concurrency "
+                "(int > 0)}")
 
 
 def task_options(updates: Dict[str, Any],

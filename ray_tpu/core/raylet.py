@@ -154,7 +154,12 @@ class Raylet:
             try:
                 await self._gcs.heartbeat(
                     self.node_id, self.resources_available,
-                    load={"pending": len(self._pending)})
+                    load={"pending": len(self._pending),
+                          # Demand shapes drive the autoscaler's
+                          # bin-packing (reference: load metrics'
+                          # resource_load_by_shape).
+                          "pending_demands": [dict(p.demand) for p in
+                                              self._pending[:100]]})
                 self._cluster_view = {
                     n["node_id"]: n for n in await self._gcs.get_nodes()}
             except Exception:

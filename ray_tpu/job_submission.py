@@ -1,0 +1,186 @@
+"""Job submission: run an entrypoint command on the cluster.
+
+Reference equivalent: `python/ray/dashboard/modules/job/` —
+JobSubmissionClient + job supervisor actors (`job_manager.py`: each job
+gets a detached supervisor actor that runs the entrypoint subprocess,
+streams logs, and reports terminal status). Here the supervisor is a
+detached actor and job metadata lives in the GCS KV, so any client
+connected to the cluster can query status/logs after the submitter
+exits.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+def _kv_key(submission_id: str) -> bytes:
+    return f"job_submission:{submission_id}".encode()
+
+
+class _JobSupervisor:
+    """Detached actor running one entrypoint subprocess (reference:
+    job_manager.py JobSupervisor)."""
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 env_vars: Optional[Dict[str, str]] = None,
+                 working_dir: Optional[str] = None):
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self._logs: List[str] = []
+        env = dict(os.environ)
+        env.update(env_vars or {})
+        self._proc = subprocess.Popen(
+            entrypoint, shell=True, cwd=working_dir or None,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+        import threading
+
+        self._reader = threading.Thread(target=self._pump, daemon=True)
+        self._reader.start()
+        self._update(JobStatus.RUNNING)
+
+    def _pump(self) -> None:
+        for line in self._proc.stdout:
+            self._logs.append(line)
+        code = self._proc.wait()
+        self._update(JobStatus.SUCCEEDED if code == 0 else
+                     JobStatus.FAILED, return_code=code)
+
+    def _update(self, status: str, **extra) -> None:
+        from ray_tpu.core.worker import current_runtime
+
+        rt = current_runtime()
+        record = {"submission_id": self.submission_id,
+                  "entrypoint": self.entrypoint, "status": status,
+                  "updated_at": time.time(), **extra}
+        rt.kv_put(_kv_key(self.submission_id), pickle.dumps(record))
+
+    def status(self) -> str:
+        if self._proc.poll() is None:
+            return JobStatus.RUNNING
+        return (JobStatus.SUCCEEDED if self._proc.returncode == 0
+                else JobStatus.FAILED)
+
+    def logs(self) -> str:
+        return "".join(self._logs)
+
+    def stop(self) -> bool:
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+            self._update(JobStatus.STOPPED)
+        return True
+
+
+class JobSubmissionClient:
+    """Reference: `ray.job_submission.JobSubmissionClient` — against the
+    cluster's GCS address instead of the dashboard HTTP endpoint."""
+
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu
+
+        ray_tpu.init(address=address, ignore_reinit_error=True)
+        self._ray = ray_tpu
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   env_vars: Optional[Dict[str, str]] = None,
+                   working_dir: Optional[str] = None) -> str:
+        submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        supervisor_cls = self._ray.remote(num_cpus=0)(_JobSupervisor)
+        supervisor = supervisor_cls.options(
+            name=f"_job_supervisor:{submission_id}",
+            lifetime="detached").remote(
+                submission_id, entrypoint, env_vars, working_dir)
+        # Surface immediate spawn failures synchronously.
+        self._ray.get(supervisor.status.remote(), timeout=60)
+        return submission_id
+
+    def _supervisor(self, submission_id: str):
+        return self._ray.get_actor(f"_job_supervisor:{submission_id}")
+
+    def _record(self, submission_id: str) -> Optional[Dict[str, Any]]:
+        from ray_tpu.core.worker import current_runtime
+
+        blob = current_runtime().kv_get(_kv_key(submission_id))
+        return pickle.loads(blob) if blob else None
+
+    def get_job_status(self, submission_id: str) -> str:
+        try:
+            sup = self._supervisor(submission_id)
+            return self._ray.get(sup.status.remote(), timeout=30)
+        except Exception:
+            record = self._record(submission_id)
+            if record is not None:
+                return record["status"]
+            raise
+
+    def get_job_info(self, submission_id: str) -> Dict[str, Any]:
+        record = self._record(submission_id)
+        if record is None:
+            raise KeyError(f"unknown job {submission_id}")
+        return record
+
+    def get_job_logs(self, submission_id: str) -> str:
+        sup = self._supervisor(submission_id)
+        return self._ray.get(sup.logs.remote(), timeout=30)
+
+    def stop_job(self, submission_id: str) -> bool:
+        sup = self._supervisor(submission_id)
+        return self._ray.get(sup.stop.remote(), timeout=60)
+
+    def delete_job(self, submission_id: str) -> bool:
+        try:
+            sup = self._supervisor(submission_id)
+            self._ray.get(sup.stop.remote(), timeout=60)
+            self._ray.kill(sup)
+        except Exception:
+            pass
+        from ray_tpu.core.worker import current_runtime
+
+        current_runtime().kv_del(_kv_key(submission_id))
+        return True
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        from ray_tpu.core.worker import current_runtime
+
+        rt = current_runtime()
+        out = []
+        keys = rt._loop.run(rt._gcs.kv_keys("job_submission:"),
+                            timeout=30) if hasattr(rt, "_gcs") else []
+        for key in keys:
+            blob = rt.kv_get(key.encode()
+                             if isinstance(key, str) else key)
+            if blob:
+                out.append(pickle.loads(blob))
+        return out
+
+    def wait_until_finished(self, submission_id: str,
+                            timeout_s: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(0.5)
+        raise TimeoutError(
+            f"job {submission_id} still running after {timeout_s}s")

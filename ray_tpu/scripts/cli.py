@@ -143,6 +143,31 @@ def cmd_perf(args) -> None:
     print(json.dumps(run_microbench(local_mode=args.local)))
 
 
+def cmd_job(args) -> None:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    addr = args.address or os.environ.get("RAY_TPU_ADDRESS")
+    client = JobSubmissionClient(addr)
+    if args.job_command == "submit":
+        sid = client.submit_job(entrypoint=" ".join(args.entrypoint),
+                                working_dir=args.working_dir)
+        print(sid)
+        if args.wait:
+            status = client.wait_until_finished(sid,
+                                                timeout_s=args.timeout)
+            print(status)
+            print(client.get_job_logs(sid), end="")
+    elif args.job_command == "status":
+        print(client.get_job_status(args.id))
+    elif args.job_command == "logs":
+        print(client.get_job_logs(args.id), end="")
+    elif args.job_command == "stop":
+        client.stop_job(args.id)
+        print("stopped")
+    elif args.job_command == "list":
+        print(json.dumps(client.list_jobs(), indent=2, default=str))
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -181,6 +206,24 @@ def main(argv=None) -> None:
     sp = sub.add_parser("perf", help="runtime microbenchmarks")
     sp.add_argument("--local", action="store_true")
     sp.set_defaults(fn=cmd_perf)
+
+    sp = sub.add_parser("job", help="submit/inspect cluster jobs")
+    jsub = sp.add_subparsers(dest="job_command", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--address")
+    j.add_argument("--working-dir", default=None)
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("--timeout", type=float, default=300.0)
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    j.set_defaults(fn=cmd_job)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("--address")
+        j.add_argument("id")
+        j.set_defaults(fn=cmd_job)
+    j = jsub.add_parser("list")
+    j.add_argument("--address")
+    j.set_defaults(fn=cmd_job)
 
     args = p.parse_args(argv)
     args.fn(args)

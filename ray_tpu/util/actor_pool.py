@@ -46,10 +46,18 @@ class ActorPool:
             # Earlier indices were consumed by get_next_unordered: the
             # "next in order" is the smallest remaining submission index.
             self._next_return_index = min(self._index_to_future)
-        future = self._index_to_future.pop(self._next_return_index)
-        self._next_return_index += 1
+        future = self._index_to_future[self._next_return_index]
         import ray_tpu
 
+        if timeout is not None:
+            # Probe first: on timeout the future stays retrievable and
+            # the actor stays booked (reference ActorPool semantics).
+            ready, _ = ray_tpu.wait([future], num_returns=1,
+                                    timeout=timeout)
+            if not ready:
+                raise TimeoutError("get_next timed out")
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
         try:
             return ray_tpu.get(future, timeout=timeout)
         finally:

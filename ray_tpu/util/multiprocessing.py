@@ -1,0 +1,172 @@
+"""multiprocessing.Pool API over ray_tpu tasks.
+
+Reference equivalent: `python/ray/util/multiprocessing/pool.py` — the
+drop-in `Pool` with apply/apply_async/map/map_async/starmap/imap/
+imap_unordered, backed by tasks instead of forked processes (so it
+scales past one host and through the scheduler).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single else out
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        import ray_tpu
+
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        import ray_tpu
+
+        ready, _ = ray_tpu.wait(self._refs,
+                                num_returns=len(self._refs), timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0.001)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """`Pool(processes=N)`: N is a concurrency hint (chunk parallelism),
+    not a process count — the cluster decides placement."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = ()):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._parallelism = processes or 8
+        self._initializer = initializer
+        self._initargs = initargs
+        self._closed = False
+
+    def _task(self, fn: Callable):
+        import ray_tpu
+
+        initializer, initargs = self._initializer, self._initargs
+
+        def run_chunk(items, star):
+            if initializer is not None:
+                initializer(*initargs)
+            if star:
+                return [fn(*item) for item in items]
+            return [fn(item) for item in items]
+
+        return ray_tpu.remote(run_chunk)
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise ValueError("Pool is closed")
+
+    # -- apply -----------------------------------------------------------
+    def apply(self, fn: Callable, args: tuple = (),
+              kwargs: Optional[dict] = None):
+        return self.apply_async(fn, args, kwargs).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwargs: Optional[dict] = None) -> AsyncResult:
+        self._check_open()
+        import ray_tpu
+
+        kw = kwargs or {}
+        ref = ray_tpu.remote(lambda: fn(*args, **kw)).remote()
+        return AsyncResult([ref], single=True)
+
+    # -- map -------------------------------------------------------------
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._parallelism * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> "_MapResult":
+        self._check_open()
+        task = self._task(fn)
+        refs = [task.remote(chunk, False)
+                for chunk in self._chunks(iterable, chunksize)]
+        return _MapResult(refs)
+
+    def starmap(self, fn: Callable, iterable: Iterable,
+                chunksize: Optional[int] = None) -> List[Any]:
+        self._check_open()
+        task = self._task(fn)
+        refs = [task.remote(chunk, True)
+                for chunk in self._chunks(iterable, chunksize)]
+        return _MapResult(refs).get()
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: int = 1):
+        self._check_open()
+        import ray_tpu
+
+        task = self._task(fn)
+        refs = [task.remote(chunk, False)
+                for chunk in self._chunks(iterable, chunksize)]
+        for ref in refs:
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: int = 1):
+        self._check_open()
+        import ray_tpu
+
+        task = self._task(fn)
+        pending = [task.remote(chunk, False)
+                   for chunk in self._chunks(iterable, chunksize)]
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            yield from ray_tpu.get(ready[0])
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("join() before close()")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+
+
+class _MapResult(AsyncResult):
+    def __init__(self, refs):
+        super().__init__(refs, single=False)
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        chunks = ray_tpu.get(self._refs, timeout=timeout)
+        return list(itertools.chain.from_iterable(chunks))

@@ -1,0 +1,96 @@
+"""Autoscaler (demand-driven node launch + idle reap) and job submission.
+
+Reference coverage class: `python/ray/tests/test_autoscaler.py` (with
+the fake multinode provider) and `dashboard/modules/job/tests/` job
+manager lifecycle tests.
+"""
+
+import time
+
+import pytest
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture()
+def small_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    yield cluster
+    cluster.shutdown()
+
+
+def test_autoscaler_launches_for_unmet_demand_and_reaps_idle(
+        small_cluster):
+    import ray_tpu
+    from ray_tpu.autoscaler import (AutoscalerConfig, LocalNodeProvider,
+                                    StandardAutoscaler)
+    from ray_tpu.autoscaler.node_provider import NodeType
+
+    provider = LocalNodeProvider(small_cluster.address)
+    scaler = StandardAutoscaler(
+        small_cluster.address, provider,
+        AutoscalerConfig(
+            node_types=[NodeType("cpu2", {"CPU": 2.0}, max_workers=2)],
+            max_workers=3, upscale_delay_s=0.2, idle_timeout_s=3.0,
+            tick_interval_s=0.5))
+    scaler.start()
+    ray_tpu.init(address=small_cluster.address, ignore_reinit_error=True)
+    try:
+        # Head has 1 CPU: a 2-CPU task is locally infeasible and must
+        # trigger a node launch.
+        def who():
+            import os
+
+            return os.getpid()
+
+        f = ray_tpu.remote(num_cpus=2)(who)
+        ref = f.remote()
+        assert isinstance(ray_tpu.get(ref, timeout=120), int)
+        assert len(provider.non_terminated_nodes()) >= 1
+
+        # Once demand drains, the idle node is terminated.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if not provider.non_terminated_nodes():
+                break
+            time.sleep(0.5)
+        assert not provider.non_terminated_nodes(), \
+            "idle node never reaped"
+    finally:
+        ray_tpu.shutdown()
+        scaler.shutdown()
+
+
+def test_job_submission_lifecycle(small_cluster):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient(small_cluster.address)
+    sid = client.submit_job(
+        entrypoint="python -c \"print('hello from job'); print(6*7)\"")
+    status = client.wait_until_finished(sid, timeout_s=120)
+    assert status == JobStatus.SUCCEEDED
+    logs = client.get_job_logs(sid)
+    assert "hello from job" in logs and "42" in logs
+    info = client.get_job_info(sid)
+    assert info["status"] == JobStatus.SUCCEEDED
+    assert any(j["submission_id"] == sid for j in client.list_jobs())
+    client.delete_job(sid)
+
+    # Failing entrypoint -> FAILED.
+    sid2 = client.submit_job(entrypoint="python -c \"raise SystemExit(3)\"")
+    assert client.wait_until_finished(sid2, timeout_s=120) \
+        == JobStatus.FAILED
+
+    # Long-running entrypoint can be stopped.
+    sid3 = client.submit_job(
+        entrypoint="python -c \"import time; time.sleep(600)\"")
+    time.sleep(1.0)
+    client.stop_job(sid3)
+    assert client.wait_until_finished(sid3, timeout_s=60) in (
+        JobStatus.STOPPED, JobStatus.FAILED)
+    import ray_tpu
+
+    ray_tpu.shutdown()

@@ -233,6 +233,38 @@ def test_batching_folds_concurrent_requests(serve_instance):
     assert max(sizes) > 1, f"no batching happened: {sizes}"
 
 
+def test_model_composition_via_handles(serve_instance):
+    """Deployments call other deployments through handles passed as init
+    args (reference: serve model composition / deployment graphs)."""
+    serve = serve_instance
+
+    @serve.deployment
+    class Preprocessor:
+        def __call__(self, x):
+            return [v * 2 for v in x]
+
+    @serve.deployment
+    class Model:
+        def __call__(self, x):
+            return sum(x)
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, pre_handle, model_handle):
+            self.pre = pre_handle
+            self.model = model_handle
+
+        def __call__(self, req):
+            halfway = self.pre.remote(req["x"]).result(timeout_s=30)
+            return {"y": self.model.remote(halfway).result(timeout_s=30)}
+
+    pre = serve.run(Preprocessor.bind(), route_prefix="/pre")
+    model = serve.run(Model.bind(), route_prefix="/m2")
+    pipeline = serve.run(Pipeline.bind(pre, model), route_prefix="/pipe")
+    out = pipeline.remote({"x": [1, 2, 3]}).result(timeout_s=60)
+    assert out == {"y": 12}
+
+
 def test_delete_deployment(serve_instance):
     serve = serve_instance
 

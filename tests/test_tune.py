@@ -52,8 +52,16 @@ def test_asha_early_stops_bad_trials(ray_cluster, tmp_path):
 
     def objective(config):
         # score grows linearly with rate `lr`: low-lr trials are provably
-        # worse at every rung and must be culled.
+        # worse at every rung and must be culled. ASYNC ASHA culls against
+        # what reached the rung EARLIER, so bad trials must be slower too
+        # (true of real workloads where bad configs diverge/limp) — with
+        # uniform speeds an ascending round-robin arrival order would
+        # legitimately never cull (same property as the reference's
+        # AsyncHyperBand).
+        import time as _time
+
         for i in range(1, 21):
+            _time.sleep(0.001 if config["lr"] >= 1.0 else 0.15)
             tune.report({"score": config["lr"] * i})
 
     tuner = tune.Tuner(

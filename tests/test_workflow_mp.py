@@ -1,0 +1,130 @@
+"""Workflow durable execution + multiprocessing.Pool clone.
+
+Reference coverage class: `python/ray/workflow/tests/test_basic_workflows.py`
++ `python/ray/tests/test_multiprocessing.py`.
+"""
+
+import os
+import time
+
+import pytest
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def wf_storage(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path))
+    return tmp_path
+
+
+# Module-level side-effect counter: steps append to a file so a resumed
+# run can prove it did NOT re-execute finished steps.
+def _count_file():
+    return os.environ["WF_COUNT_FILE"]
+
+
+def test_workflow_runs_and_resumes_from_checkpoints(ray_cluster,
+                                                    wf_storage,
+                                                    tmp_path,
+                                                    monkeypatch):
+    import ray_tpu
+    from ray_tpu import workflow
+
+    count_file = str(tmp_path / "executions.log")
+
+    def fetch(log):
+        with open(log, "a") as f:
+            f.write("fetch\n")
+        return [1, 2, 3]
+
+    def total(xs, log):
+        with open(log, "a") as f:
+            f.write("total\n")
+        return sum(xs)
+
+    fetch_t = ray_tpu.remote(fetch)
+    total_t = ray_tpu.remote(total)
+    dag = total_t.bind(fetch_t.bind(count_file), count_file)
+
+    out = workflow.run(dag, workflow_id="wf-basic")
+    assert out == 6
+    status = workflow.get_status("wf-basic")
+    assert status["status"] == "SUCCEEDED"
+    assert len(status["steps_ran"]) == 2
+
+    # Resume: both steps replay from storage, nothing re-executes.
+    out2 = workflow.resume("wf-basic")
+    assert out2 == 6
+    status2 = workflow.get_status("wf-basic")
+    assert len(status2["steps_loaded"]) == 2
+    assert status2["steps_ran"] == []
+    with open(count_file) as f:
+        lines = f.read().strip().splitlines()
+    assert sorted(lines) == ["fetch", "total"], lines
+
+    assert any(w["workflow_id"] == "wf-basic"
+               for w in workflow.list_all())
+    workflow.delete("wf-basic")
+    with pytest.raises(KeyError):
+        workflow.get_status("wf-basic")
+
+
+def test_workflow_failed_step_then_resume_completes(ray_cluster,
+                                                    wf_storage,
+                                                    tmp_path,
+                                                    monkeypatch):
+    import ray_tpu
+    from ray_tpu import workflow
+
+    flag = tmp_path / "now_works"
+
+    def good():
+        return 10
+
+    def flaky(x, flag_file):
+        if not os.path.exists(flag_file):
+            raise RuntimeError("transient failure")
+        return x * 2
+
+    dag = ray_tpu.remote(flaky).bind(ray_tpu.remote(good).bind(),
+                                     str(flag))
+    with pytest.raises(RuntimeError):
+        workflow.run(dag, workflow_id="wf-flaky")
+    assert workflow.get_status("wf-flaky")["status"] == "FAILED"
+
+    flag.write_text("ok")
+    out = workflow.resume("wf-flaky")
+    assert out == 20
+    status = workflow.get_status("wf-flaky")
+    # `good` came from its checkpoint; only `flaky` re-ran.
+    assert len(status["steps_loaded"]) == 1
+    assert len(status["steps_ran"]) == 1
+
+
+def test_multiprocessing_pool(ray_cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        assert pool.map(lambda x: x * x, range(10)) == \
+            [x * x for x in range(10)]
+        assert pool.apply(lambda a, b: a + b, (3, 4)) == 7
+        r = pool.apply_async(lambda: 99)
+        assert r.get(timeout=60) == 99
+        assert pool.starmap(lambda a, b: a * b, [(2, 3), (4, 5)]) == \
+            [6, 20]
+        assert list(pool.imap(lambda x: x + 1, range(5))) == \
+            [1, 2, 3, 4, 5]
+        assert sorted(pool.imap_unordered(lambda x: x + 1, range(5))) == \
+            [1, 2, 3, 4, 5]
+    with pytest.raises(ValueError):
+        pool.map(lambda x: x, [1])
