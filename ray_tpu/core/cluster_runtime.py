@@ -1929,7 +1929,7 @@ class ClusterRuntime:
                     if not e["cond"]._waiters:
                         del self._actor_seq[key]
             entry = {"next": None, "cond": asyncio.Condition(),
-                     "skipped": set()}
+                     "skipped": set(), "waiting": 0}
             self._actor_seq[caller] = entry
         return entry
 
@@ -1951,39 +1951,55 @@ class ClusterRuntime:
         if seq is None:
             return
         entry = self._actor_seq_entry(spec.get("owner", ""))
-        async with entry["cond"]:
-            if entry["next"] is None:
-                # First task seen from this caller (fresh worker, or the
-                # caller reconnected after a restart): adopt its seq.
-                entry["next"] = seq
-            while entry["next"] < seq:
-                if entry["next"] in entry["skipped"]:
-                    # Explicitly-skipped hole (cancelled pre-push).
-                    entry["skipped"].discard(entry["next"])
-                    entry["next"] += 1
-                    continue
-                try:
-                    await asyncio.wait_for(entry["cond"].wait(),
-                                           timeout=60.0)
-                except asyncio.TimeoutError:
-                    # A predecessor seq was consumed caller-side but its
-                    # push never arrived (e.g. failed before send):
-                    # liveness over strictness — adopt this seq.
-                    entry["next"] = seq
+        # Fast path: everything here runs on the one worker event loop,
+        # so plain dict reads/writes are race-free between awaits — the
+        # Condition is only needed when this task actually has to wait
+        # (out-of-order arrival, which TCP ordering makes rare).
+        while entry["next"] is not None and entry["next"] < seq:
+            if entry["next"] in entry["skipped"]:
+                # Explicitly-skipped hole (cancelled pre-push).
+                entry["skipped"].discard(entry["next"])
+                entry["next"] += 1
+                continue
+            # Announce intent-to-wait synchronously (single-threaded
+            # loop: no await between here and _advance's check), so the
+            # advancer can't miss us while cond.wait() is still
+            # registering its waiter.
+            entry["waiting"] += 1
+            try:
+                async with entry["cond"]:
+                    if entry["next"] is not None and entry["next"] >= seq:
+                        break
+                    try:
+                        await asyncio.wait_for(entry["cond"].wait(),
+                                               timeout=60.0)
+                    except asyncio.TimeoutError:
+                        # A predecessor seq was consumed caller-side but
+                        # its push never arrived (failed before send):
+                        # liveness over strictness — adopt this seq.
+                        entry["next"] = seq
+            finally:
+                entry["waiting"] -= 1
+        if entry["next"] is None:
+            # First task seen from this caller (fresh worker, or the
+            # caller reconnected after a restart): adopt its seq.
+            entry["next"] = seq
 
     def _advance_actor_turn(self, spec: dict) -> None:
         seq = spec.get("seq")
         if seq is None:
             return
         entry = self._actor_seq_entry(spec.get("owner", ""))
+        if entry["next"] is not None and entry["next"] == seq:
+            entry["next"] = seq + 1
+        if not entry["waiting"]:
+            return  # nobody waiting (or registering): skip the notify
 
-        async def bump():
+        async def notify():
             async with entry["cond"]:
-                if entry["next"] is not None and entry["next"] == seq:
-                    entry["next"] = seq + 1
                 entry["cond"].notify_all()
 
-        asyncio.ensure_future(bump())
+        asyncio.ensure_future(notify())
 
     async def handle_exit_worker(self, conn: ServerConnection) -> bool:
         import asyncio

@@ -7,10 +7,12 @@ disjoint shard (`session.get_dataset_shard`).
 """
 
 from ray_tpu.data.block import Block
-from ray_tpu.data.dataset import (Dataset, from_items, from_numpy, range,
-                                  read_csv, read_parquet)
+from ray_tpu.data.dataset import (Dataset, GroupedData, from_items,
+                                  from_numpy, from_pandas, range,
+                                  read_csv, read_json, read_parquet)
 
 __all__ = [
-    "Block", "Dataset", "range", "from_items", "from_numpy",
-    "read_csv", "read_parquet",
+    "Block", "Dataset", "GroupedData", "range", "from_items",
+    "from_numpy", "from_pandas", "read_csv", "read_json",
+    "read_parquet",
 ]

@@ -16,7 +16,8 @@ from typing import Any, Callable, Dict, Iterator, List, Optional
 import numpy as np
 
 from ray_tpu.data.block import (Block, block_from_rows, block_num_rows,
-                                block_to_rows, concat_blocks, rebatch)
+                                block_slice, block_to_rows, concat_blocks,
+                                rebatch)
 from ray_tpu.data.executor import StreamingExecutor
 
 
@@ -26,9 +27,19 @@ class Dataset:
         self._read_tasks = read_tasks
         self._transforms = list(transforms or [])
 
+    _limit: Optional[int] = None
+
+    def _check_not_limited(self, op: str) -> None:
+        if self._limit is not None:
+            raise NotImplementedError(
+                f".{op}() after .limit() is not supported — apply "
+                "transforms first, then limit (limit is a terminal "
+                "streaming cut; silently ignoring it would be worse)")
+
     # -- transforms (lazy) ----------------------------------------------
     def map_batches(self, fn: Callable[[Block], Block],
                     **_ignored: Any) -> "Dataset":
+        self._check_not_limited("map_batches")
         return Dataset(self._read_tasks, self._transforms + [fn])
 
     def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]
@@ -45,6 +56,96 @@ class Dataset:
 
         return self.map_batches(_filter_block)
 
+    def flat_map(self, fn: Callable[[Dict[str, Any]],
+                                    List[Dict[str, Any]]]) -> "Dataset":
+        """Row -> many rows (reference: dataset.py flat_map)."""
+        def _flat_block(block: Block) -> Block:
+            rows: List[Dict[str, Any]] = []
+            for r in block_to_rows(block):
+                rows.extend(fn(r))
+            return block_from_rows(rows)
+
+        return self.map_batches(_flat_block)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets (transforms must already be baked: each
+        input keeps its own chain by wrapping reads)."""
+        self._check_not_limited("union")
+        for other in others:
+            other._check_not_limited("union")
+
+        def bake(ds: "Dataset") -> List[Callable[[], Block]]:
+            def wrap(task, transforms):
+                def run() -> Block:
+                    block = task()
+                    for t in transforms:
+                        block = t(block)
+                    return block
+
+                return run
+
+            return [wrap(t, list(ds._transforms))
+                    for t in ds._read_tasks]
+
+        tasks = bake(self)
+        for other in others:
+            tasks += bake(other)
+        return Dataset(tasks)
+
+    def limit(self, n: int) -> "Dataset":
+        """First n rows — a terminal streaming cut honored by every
+        consumer (iter_blocks stops pulling once satisfied; reference:
+        LimitOperator). Transforms must be applied before limit."""
+        ds = Dataset(self._read_tasks, self._transforms)
+        ds._limit = n if self._limit is None else min(n, self._limit)
+        return ds
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Materialize + re-split into equal blocks (reference:
+        repartition; an all-to-all op, so it executes eagerly)."""
+        self._check_not_limited("repartition")
+        block = self.materialize()
+        total = block_num_rows(block)
+        num_blocks = max(1, min(num_blocks, total or 1))
+        bounds = np.linspace(0, total, num_blocks + 1).astype(int)
+
+        def make_task(lo: int, hi: int):
+            return lambda: block_slice(block, lo, hi)
+
+        return Dataset([make_task(bounds[i], bounds[i + 1])
+                        for i in builtins.range(num_blocks)])
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Global shuffle (materializing all-to-all, like the
+        reference's random_shuffle)."""
+        self._check_not_limited("random_shuffle")
+        block = self.materialize()
+        total = block_num_rows(block)
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(total)
+        shuffled = {c: np.asarray(v)[order] for c, v in block.items()}
+        n_blocks = max(1, len(self._read_tasks))
+        bounds = np.linspace(0, total, n_blocks + 1).astype(int)
+
+        def make_task(lo: int, hi: int):
+            return lambda: block_slice(shuffled, lo, hi)
+
+        return Dataset([make_task(bounds[i], bounds[i + 1])
+                        for i in builtins.range(n_blocks)])
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Global sort by column (materializing all-to-all)."""
+        self._check_not_limited("sort")
+        block = self.materialize()
+        order = np.argsort(np.asarray(block[key]), kind="stable")
+        if descending:
+            order = order[::-1]
+        out = {c: np.asarray(v)[order] for c, v in block.items()}
+        return Dataset([lambda: out])
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
     # -- execution ------------------------------------------------------
     def _executor(self, max_in_flight: int = 4) -> StreamingExecutor:
         return StreamingExecutor(self._read_tasks, self._transforms,
@@ -54,9 +155,25 @@ class Dataset:
         import ray_tpu
 
         ex = self._executor(max_in_flight)
-        if ray_tpu.is_initialized():
-            return iter(ex)
-        return ex.run_local()
+        blocks = (iter(ex) if ray_tpu.is_initialized()
+                  else ex.run_local())
+        if self._limit is None:
+            return blocks
+        return self._limited(blocks, self._limit)
+
+    @staticmethod
+    def _limited(blocks: Iterator[Block], limit: int) -> Iterator[Block]:
+        """Row-exact streaming cut: stops pulling upstream once
+        satisfied, so every consumer (batches, writes, pandas, schema)
+        honors limit()."""
+        remaining = limit
+        for block in blocks:
+            n = block_num_rows(block)
+            if n >= remaining:
+                yield block_slice(block, 0, remaining)
+                return
+            remaining -= n
+            yield block
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      prefetch_blocks: int = 4,
@@ -79,8 +196,36 @@ class Dataset:
                 break
         return out
 
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
     def count(self) -> int:
         return sum(block_num_rows(b) for b in self.iter_blocks())
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.materialize())
+
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pandas as pd
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            pd.DataFrame(block).to_parquet(
+                os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_csv(self, path: str) -> None:
+        import os
+
+        import pandas as pd
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            pd.DataFrame(block).to_csv(
+                os.path.join(path, f"part-{i:05d}.csv"), index=False)
 
     def materialize(self) -> Block:
         return concat_blocks(list(self.iter_blocks()))
@@ -97,6 +242,7 @@ class Dataset:
 
     # -- sharding (reference: DataConfig per-worker shards) --------------
     def split(self, n: int) -> List["Dataset"]:
+        self._check_not_limited("split")
         # builtins.range: the module-level `range` is the Dataset factory.
         return [Dataset(self._read_tasks[i::n], self._transforms)
                 for i in builtins.range(n)]
@@ -111,6 +257,52 @@ class Dataset:
     def __repr__(self) -> str:
         return (f"Dataset(num_blocks={self.num_blocks}, "
                 f"num_transforms={len(self._transforms)})")
+
+
+class GroupedData:
+    """Reference: grouped_data.py — hash-grouped aggregations."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _groups(self) -> Dict[Any, List[Dict[str, Any]]]:
+        groups: Dict[Any, List[Dict[str, Any]]] = {}
+        for row in self._ds.iter_rows():
+            groups.setdefault(row[self._key], []).append(row)
+        return groups
+
+    def _ordered(self):
+        """Sorted by key when orderable, else insertion order (mixed or
+        None keys must group, not crash)."""
+        groups = self._groups()
+        try:
+            return sorted(groups.items())
+        except TypeError:
+            return list(groups.items())
+
+    def count(self) -> Dataset:
+        rows = [{self._key: k, "count()": len(v)}
+                for k, v in self._ordered()]
+        return Dataset([lambda rows=rows: block_from_rows(rows)])
+
+    def sum(self, on: str) -> Dataset:
+        rows = [{self._key: k, f"sum({on})": sum(r[on] for r in v)}
+                for k, v in self._ordered()]
+        return Dataset([lambda rows=rows: block_from_rows(rows)])
+
+    def mean(self, on: str) -> Dataset:
+        rows = [{self._key: k,
+                 f"mean({on})": sum(r[on] for r in v) / len(v)}
+                for k, v in self._ordered()]
+        return Dataset([lambda rows=rows: block_from_rows(rows)])
+
+    def map_groups(self, fn: Callable[[List[Dict[str, Any]]],
+                                      List[Dict[str, Any]]]) -> Dataset:
+        rows: List[Dict[str, Any]] = []
+        for _, group in self._ordered():
+            rows.extend(fn(group))
+        return Dataset([lambda rows=rows: block_from_rows(rows)])
 
 
 # ---------------------------------------------------------------------
@@ -207,3 +399,41 @@ def read_csv(paths, **read_kwargs: Any) -> Dataset:
         return read
 
     return Dataset([make_task(f) for f in files])
+
+
+def read_json(paths, *, lines: bool = True) -> Dataset:
+    """JSONL (default) or JSON-array files (reference: read_json)."""
+    files = _expand_paths(paths)
+
+    def make_task(path: str) -> Callable[[], Block]:
+        def read() -> Block:
+            import json
+
+            rows: List[Dict[str, Any]] = []
+            with open(path) as f:
+                if lines:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            rows.append(json.loads(line))
+                else:
+                    rows = json.load(f)
+            return block_from_rows(rows)
+
+        return read
+
+    return Dataset([make_task(f) for f in files])
+
+
+def from_pandas(df, *, parallelism: int = 4) -> Dataset:
+    """DataFrame -> Dataset (reference: from_pandas)."""
+    n = len(df)
+    parallelism = max(1, min(parallelism, n or 1))
+    bounds = np.linspace(0, n, parallelism + 1).astype(int)
+
+    def make_task(lo: int, hi: int) -> Callable[[], Block]:
+        chunk = df.iloc[lo:hi]
+        return lambda: {c: chunk[c].to_numpy() for c in chunk.columns}
+
+    return Dataset([make_task(bounds[i], bounds[i + 1])
+                    for i in builtins.range(parallelism)])

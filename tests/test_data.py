@@ -166,3 +166,80 @@ def test_train_ingest_disjoint_shards(ray_cluster):
     ids0 = result.metrics["ids"]
     assert len(ids0) == 32
     assert set(ids0).issubset(set(range(64)))
+
+
+def test_data_ops_widened(ray_cluster, tmp_path):
+    """flat_map / union / limit / sort / shuffle / groupby / repartition
+    / json + pandas round trips (reference: dataset.py op surface)."""
+    from ray_tpu import data
+
+    ds = data.range(10, parallelism=3)
+    assert ds.limit(4).count() == 4
+    assert [r["id"] for r in ds.limit(3).take_all()] == [0, 1, 2]
+
+    doubled = ds.flat_map(lambda r: [r, r])
+    assert doubled.count() == 20
+
+    u = data.range(3).union(data.range(2))
+    assert u.count() == 5
+
+    srt = data.from_items([3, 1, 2]).sort("item")
+    assert [r["item"] for r in srt.take_all()] == [1, 2, 3]
+    srt_d = data.from_items([3, 1, 2]).sort("item", descending=True)
+    assert [r["item"] for r in srt_d.take_all()] == [3, 2, 1]
+
+    shuffled = data.range(50, parallelism=4).random_shuffle(seed=7)
+    vals = [r["id"] for r in shuffled.take_all()]
+    assert sorted(vals) == list(range(50)) and vals != list(range(50))
+
+    rp = data.range(12, parallelism=2).repartition(4)
+    assert rp.num_blocks == 4 and rp.count() == 12
+
+    g = data.from_items(["a", "b", "a", "a"]).groupby("item").count()
+    rows = {r["item"]: r["count()"] for r in g.take_all()}
+    assert rows == {"a": 3, "b": 1}
+    s = data.from_numpy({"k": __import__("numpy").array([1, 1, 2]),
+                         "v": __import__("numpy").array([10, 20, 5])}
+                        ).groupby("k").sum("v")
+    assert {r["k"]: r["sum(v)"] for r in s.take_all()} == {1: 30, 2: 5}
+
+    # json round trip
+    jpath = tmp_path / "rows.jsonl"
+    jpath.write_text('{"x": 1}\n{"x": 2}\n')
+    assert data.read_json(str(jpath)).count() == 2
+
+    # pandas + parquet round trips
+    import pandas as pd
+
+    df = pd.DataFrame({"a": [1, 2, 3]})
+    ds2 = data.from_pandas(df, parallelism=2)
+    assert ds2.to_pandas()["a"].tolist() == [1, 2, 3]
+    out = tmp_path / "pq"
+    ds2.write_parquet(str(out))
+    assert data.read_parquet(str(out)).count() == 3
+
+
+def test_limit_is_honored_everywhere_or_rejected(ray_cluster, tmp_path):
+    """limit() cuts every consumer (batches, pandas, writes); chaining a
+    transform after limit raises instead of silently ignoring it."""
+    import pytest as _pytest
+
+    from ray_tpu import data
+
+    ds = data.range(100, parallelism=4).limit(10)
+    assert ds.count() == 10
+    assert sum(len(b["id"]) for b in ds.iter_batches(batch_size=3)) == 10
+    assert len(ds.to_pandas()) == 10
+    out = tmp_path / "lim"
+    ds.write_csv(str(out))
+    assert data.read_csv(str(out)).count() == 10
+    with _pytest.raises(NotImplementedError, match="limit"):
+        ds.map(lambda r: r)
+    with _pytest.raises(NotImplementedError, match="limit"):
+        ds.random_shuffle()
+    # mixed/unorderable group keys don't crash aggregation
+    g = data.from_items([{"k": None, "v": 1}, {"k": 1, "v": 2},
+                         {"k": None, "v": 3}])
+    counts = {str(r["k"]): r["count()"]
+              for r in data.Dataset.groupby(g, "k").count().take_all()}
+    assert counts == {"None": 2, "1": 1}
