@@ -79,6 +79,17 @@ def main() -> None:
         notes = json.loads(out.stdout.strip().splitlines()[-1])
     except Exception:
         pass
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.rllib.bench"],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        notes["rl_env_steps_per_sec"] = float(
+            out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        # In-band failure record: a missing north-star metric must be
+        # distinguishable from a broken bench.
+        notes["rl_bench_error"] = repr(e)
 
     print(json.dumps({
         "metric": "lm_train_tokens_per_sec_per_chip",

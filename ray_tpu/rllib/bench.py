@@ -1,0 +1,34 @@
+"""RLlib throughput benchmark: the BASELINE north-star #1 shape.
+
+Runs the real actor-based PPO stack (LearnerGroup + remote EnvRunners,
+weight sync included) on CartPole-v1 and prints the median steady-state
+env-steps/sec — the same metric `PPO.train()` reports. Invoked by
+bench.py as `python -m ray_tpu.rllib.bench`; runnable standalone.
+"""
+
+from __future__ import annotations
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ray_tpu
+    from ray_tpu.rllib import PPOConfig
+
+    ray_tpu.init(num_cpus=4)
+    algo = PPOConfig(num_env_runners=2, num_envs_per_runner=8,
+                     rollout_fragment_length=64, num_epochs=4,
+                     minibatch_size=256, platform="cpu").build()
+    try:
+        algo.train()  # warmup: worker spawn + XLA compile
+        rates = sorted(algo.train()["env_steps_per_sec"]
+                       for _ in range(5))
+        print(round(rates[len(rates) // 2], 1), flush=True)
+    finally:
+        algo.stop()
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
