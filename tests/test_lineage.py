@@ -86,7 +86,10 @@ def test_chained_reconstruction(recon_cluster):
     cluster.kill_node(victim)
     cluster.add_node(num_cpus=2, resources={"recon": 1.0})
 
-    value = ray.get(c, timeout=120)
+    # Generous timeout: chained re-execution needs fresh leases on the
+    # replacement node, which on a contended 1-CPU CI box can take well
+    # over a minute end to end.
+    value = ray.get(c, timeout=300)
     assert float(value[10]) == 20.0
     assert len(value) == 150000
     with open(log_c) as f:
