@@ -1926,7 +1926,7 @@ class ClusterRuntime:
                 # long-gone callers); adopt-first-seen re-seeds any that
                 # come back.
                 for key, e in list(self._actor_seq.items()):
-                    if not e["cond"]._waiters:
+                    if not e["cond"]._waiters and not e["waiting"]:
                         del self._actor_seq[key]
             entry = {"next": None, "cond": asyncio.Condition(),
                      "skipped": set(), "waiting": 0}
@@ -1968,6 +1968,14 @@ class ClusterRuntime:
             entry["waiting"] += 1
             try:
                 async with entry["cond"]:
+                    # Full re-check under the lock, INCLUDING skip holes:
+                    # a skip notification can land while we were queued
+                    # on the lock, and missing it here would stall 60s.
+                    while (entry["next"] is not None
+                           and entry["next"] < seq
+                           and entry["next"] in entry["skipped"]):
+                        entry["skipped"].discard(entry["next"])
+                        entry["next"] += 1
                     if entry["next"] is not None and entry["next"] >= seq:
                         break
                     try:

@@ -106,7 +106,10 @@ class Dataset:
         self._check_not_limited("repartition")
         block = self.materialize()
         total = block_num_rows(block)
-        num_blocks = max(1, min(num_blocks, total or 1))
+        # More blocks than rows yields empty blocks (reference behavior)
+        # — callers like split_for_workers(n) rely on getting exactly the
+        # block count they asked for.
+        num_blocks = max(1, num_blocks)
         bounds = np.linspace(0, total, num_blocks + 1).astype(int)
 
         def make_task(lo: int, hi: int):
@@ -207,25 +210,23 @@ class Dataset:
 
         return pd.DataFrame(self.materialize())
 
-    def write_parquet(self, path: str) -> None:
+    def _write_parts(self, path: str, suffix: str, write) -> None:
         import os
 
         import pandas as pd
 
         os.makedirs(path, exist_ok=True)
         for i, block in enumerate(self.iter_blocks()):
-            pd.DataFrame(block).to_parquet(
-                os.path.join(path, f"part-{i:05d}.parquet"))
+            write(pd.DataFrame(block),
+                  os.path.join(path, f"part-{i:05d}.{suffix}"))
+
+    def write_parquet(self, path: str) -> None:
+        self._write_parts(path, "parquet",
+                          lambda df, p: df.to_parquet(p))
 
     def write_csv(self, path: str) -> None:
-        import os
-
-        import pandas as pd
-
-        os.makedirs(path, exist_ok=True)
-        for i, block in enumerate(self.iter_blocks()):
-            pd.DataFrame(block).to_csv(
-                os.path.join(path, f"part-{i:05d}.csv"), index=False)
+        self._write_parts(path, "csv",
+                          lambda df, p: df.to_csv(p, index=False))
 
     def materialize(self) -> Block:
         return concat_blocks(list(self.iter_blocks()))
@@ -418,6 +419,11 @@ def read_json(paths, *, lines: bool = True) -> Dataset:
                             rows.append(json.loads(line))
                 else:
                     rows = json.load(f)
+                    if not isinstance(rows, list):
+                        raise ValueError(
+                            f"{path}: expected a JSON array of row "
+                            f"objects, got {type(rows).__name__}; for "
+                            "one-object-per-line files use lines=True")
             return block_from_rows(rows)
 
         return read
