@@ -106,6 +106,14 @@ _D("scheduler_spread_threshold", 0.5,
    "Hybrid policy utilization threshold below which tasks pack on the local "
    "node (reference: hybrid_scheduling_policy.h).")
 _D("object_timeout_ms", 100, "Plasma get poll interval.")
+_D("native_object_store", True,
+   "Use the C++ shared-memory object store (ray_tpu/native/store.cc) when "
+   "the toolchain can build it; falls back to the Python store otherwise.")
+_D("object_spilling_enabled", True,
+   "Spill LRU objects to disk instead of evicting when the store is full "
+   "(native store only; reference: local_object_manager.h SpillObjects).")
+_D("object_spill_dir", "",
+   "Spill directory; empty = /tmp/ray_tpu_spill_<node_id>.")
 _D("memory_monitor_refresh_ms", 250, "OOM monitor interval; 0 disables.")
 _D("memory_usage_threshold", 0.95, "Node memory fraction that triggers the OOM killer.")
 

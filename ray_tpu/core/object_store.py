@@ -26,9 +26,12 @@ SHM_PREFIX = "rtpu_"
 
 
 def shm_name_for(object_id_hex: str) -> str:
-    # shm names are limited (~31 chars portable); ids are unique enough
-    # truncated.
-    return SHM_PREFIX + object_id_hex[:24]
+    """shm names are limited (~31 chars portable). An ObjectID is
+    TaskID(48 hex) + index(8 hex) — sibling returns/puts of one task differ
+    ONLY in the trailing index, so the name must keep the tail."""
+    if len(object_id_hex) <= 25:
+        return SHM_PREFIX + object_id_hex
+    return SHM_PREFIX + object_id_hex[:17] + object_id_hex[-8:]
 
 
 @dataclass
@@ -104,6 +107,13 @@ class LocalObjectStore:
             return None
         self._objects.move_to_end(oid)  # LRU touch
         return entry.shm.name, entry.size
+
+    def size_of(self, oid: str) -> Optional[int]:
+        """Sealed-object size for metadata queries (no LRU touch)."""
+        entry = self._objects.get(oid)
+        if entry is None or not entry.sealed:
+            return None
+        return entry.size
 
     def read_bytes(self, oid: str) -> bytes:
         entry = self._objects.get(oid)
@@ -186,6 +196,181 @@ class LocalObjectStore:
     def shutdown(self) -> None:
         for oid in list(self._objects):
             self.delete(oid)
+
+
+class NativeObjectStore:
+    """ctypes facade over the C++ store (`ray_tpu/native/store.cc`) with the
+    same interface as `LocalObjectStore`, plus disk spilling: when the store
+    fills, LRU sealed/unpinned objects move to disk and transparently
+    restore on the next `info`/read (reference:
+    `src/ray/raylet/local_object_manager.h:41`)."""
+
+    _NAME_CAP = 64
+
+    def __init__(self, capacity_bytes: int, *, prefix: str,
+                 spill_dir: Optional[str]):
+        from ray_tpu.native import native_store_lib
+
+        self._lib = native_store_lib()
+        if self._lib is None:
+            raise RuntimeError("native store library unavailable")
+        self.capacity = capacity_bytes
+        self._prefix = prefix
+        self._h = self._lib.rts_open(
+            prefix.encode(), (spill_dir or "").encode(), capacity_bytes)
+        if not self._h:
+            raise RuntimeError("native store init failed")
+
+    # rc values mirror store.cc's `enum Rc`.
+    def _shm_name(self, oid: str) -> str:
+        # MUST match store.cc shm_name_for(): keep the oid's trailing 8 hex
+        # chars — sibling returns/puts of one task differ only there.
+        room = 30 - len(self._prefix)
+        if len(oid) <= room:
+            return self._prefix + oid
+        return self._prefix + oid[: room - 8] + oid[-8:]
+
+    @property
+    def used(self) -> int:
+        return self._lib.rts_used(self._h)
+
+    def create(self, oid: str, size: int) -> str:
+        rc = self._lib.rts_create(self._h, oid.encode(), size)
+        if rc == -1:
+            raise FileExistsError(f"object {oid[:8]} already sealed")
+        if rc == -2:
+            raise MemoryError(
+                f"object of {size} bytes exceeds store capacity "
+                f"{self.capacity}")
+        if rc == -3:
+            from ray_tpu.exceptions import ObjectStoreFullError
+            raise ObjectStoreFullError(
+                f"store full: need {size} and nothing evictable")
+        if rc not in (0, 1):
+            raise OSError(f"native store create failed (rc={rc})")
+        return self._shm_name(oid)
+
+    def seal(self, oid: str) -> None:
+        if self._lib.rts_seal(self._h, oid.encode()) != 0:
+            raise KeyError(f"cannot seal unknown object {oid[:8]}")
+
+    def put_bytes(self, oid: str, data: bytes) -> None:
+        if self.contains(oid):
+            return
+        try:
+            self.create(oid, len(data))
+        except FileExistsError:
+            # Concurrent executor-thread put/pull sealed it between
+            # contains() and create(): already present, nothing to do.
+            return
+        self.write_range(oid, 0, data)
+        self.seal(oid)
+
+    def contains(self, oid: str) -> bool:
+        return bool(self._lib.rts_contains(self._h, oid.encode()))
+
+    def info(self, oid: str) -> Optional[Tuple[str, int]]:
+        import ctypes
+
+        name = ctypes.create_string_buffer(self._NAME_CAP)
+        size = ctypes.c_uint64()
+        rc = self._lib.rts_info(self._h, oid.encode(), name, self._NAME_CAP,
+                                ctypes.byref(size))
+        if rc != 0:
+            return None
+        return name.value.decode(), size.value
+
+    def size_of(self, oid: str) -> Optional[int]:
+        """Sealed-object size without forcing a spilled copy to restore."""
+        n = self._lib.rts_size(self._h, oid.encode())
+        return None if n < 0 else n
+
+    def read_bytes(self, oid: str) -> bytes:
+        size = self.size_of(oid)
+        if size is None:
+            raise KeyError(f"object {oid[:8]} not present/sealed")
+        return self.read_range(oid, 0, size)
+
+    def read_range(self, oid: str, offset: int, length: int) -> bytes:
+        import ctypes
+
+        buf = ctypes.create_string_buffer(max(length, 1))
+        n = self._lib.rts_read(self._h, oid.encode(), offset, length, buf)
+        if n < 0:
+            raise KeyError(f"object {oid[:8]} not present/sealed (rc={n})")
+        return buf.raw[:n]
+
+    def write_range(self, oid: str, offset: int, data: bytes) -> None:
+        rc = self._lib.rts_write(self._h, oid.encode(), offset,
+                                 bytes(data), len(data))
+        if rc == -4:
+            raise KeyError(f"object {oid[:8]} was not created")
+        if rc not in (0,):
+            raise OSError(f"native store write failed (rc={rc})")
+
+    def pin(self, oid: str, worker_id: str) -> None:
+        self._lib.rts_pin(self._h, oid.encode(), worker_id.encode())
+
+    def unpin(self, oid: str, worker_id: str) -> None:
+        self._lib.rts_unpin(self._h, oid.encode(), worker_id.encode())
+
+    def unpin_worker(self, worker_id: str) -> None:
+        """Drop every pin a (dead) worker held."""
+        self._lib.rts_unpin_worker(self._h, worker_id.encode())
+
+    def delete(self, oid: str) -> bool:
+        return self._lib.rts_delete(self._h, oid.encode()) == 0
+
+    def object_inventory(self) -> list:
+        import ctypes
+        import json
+
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            need = self._lib.rts_inventory(self._h, buf, cap)
+            if need < cap:
+                return json.loads(buf.value.decode())
+            cap = need + 1024
+
+    def stats(self) -> Dict[str, float]:
+        import ctypes
+
+        out = (ctypes.c_uint64 * 5)()
+        self._lib.rts_stats(self._h, out)
+        return {"capacity": out[0], "used": out[1], "num_objects": out[2],
+                "num_spilled": out[3], "spilled_bytes": out[4],
+                "backend": "native"}
+
+    def shutdown(self) -> None:
+        if self._h:
+            self._lib.rts_shutdown(self._h)
+            self._lib.rts_close(self._h)
+            self._h = None
+
+
+def make_store(capacity_bytes: int, *, node_id: str = ""):
+    """Store factory: native C++ store when buildable and enabled, else the
+    Python one. The prefix tags segment names per store instance so two
+    co-located raylets holding the same object id never collide."""
+    from ray_tpu.core.config import ray_config
+
+    cfg = ray_config()
+    if cfg.native_object_store:
+        try:
+            import os
+
+            prefix = f"rt{(node_id or str(os.getpid()))[:6]}_"
+            spill_dir = None
+            if cfg.object_spilling_enabled:
+                spill_dir = (cfg.object_spill_dir
+                             or f"/tmp/ray_tpu_spill_{node_id or os.getpid()}")
+            return NativeObjectStore(capacity_bytes, prefix=prefix,
+                                     spill_dir=spill_dir)
+        except Exception as exc:  # noqa: BLE001
+            logger.warning("native store unavailable (%s); "
+                           "using Python store", exc)
+    return LocalObjectStore(capacity_bytes)
 
 
 def _untrack(shm: shared_memory.SharedMemory) -> None:

@@ -21,7 +21,7 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.core.config import ray_config
 from ray_tpu.core.gcs.client import GcsClient
-from ray_tpu.core.object_store import LocalObjectStore
+from ray_tpu.core.object_store import NativeObjectStore, make_store
 from ray_tpu.core.rpc import RpcClient, RpcServer, ServerConnection
 
 logger = logging.getLogger(__name__)
@@ -89,8 +89,9 @@ class Raylet:
         self.resources_available = dict(resources)
         self._rpc = RpcServer(self, host, port)
         self._gcs = GcsClient(gcs_address)
-        self.store = LocalObjectStore(
-            object_store_memory or ray_config().object_store_memory_bytes)
+        self.store = make_store(
+            object_store_memory or ray_config().object_store_memory_bytes,
+            node_id=node_id)
         self._workers: Dict[str, _Worker] = {}
         self._idle: List[_Worker] = []
         self._pending: List[_PendingLease] = []
@@ -613,9 +614,20 @@ class Raylet:
     # ------------------------------------------------------------------
     # object store RPCs (reference: plasma protocol + object_manager)
     # ------------------------------------------------------------------
+    async def _store_io(self, fn, *args):
+        """Run a store op that may do disk I/O (spill victims on create,
+        restore on info/read — native store) off the event loop so a
+        multi-GB spill can't stall heartbeats and every other RPC. The
+        C++ store is internally locked; the Python store is not
+        thread-safe, so it stays on-loop (it never touches disk)."""
+        if isinstance(self.store, NativeObjectStore):
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(None, fn, *args)
+        return fn(*args)
+
     async def handle_create_object(self, conn: ServerConnection, *,
                                    oid: str, size: int) -> str:
-        return self.store.create(oid, size)
+        return await self._store_io(self.store.create, oid, size)
 
     async def handle_seal_object(self, conn: ServerConnection, *,
                                  oid: str) -> bool:
@@ -624,7 +636,7 @@ class Raylet:
 
     async def handle_object_info(self, conn: ServerConnection, *,
                                  oid: str) -> Optional[Dict[str, Any]]:
-        info = self.store.info(oid)
+        info = await self._store_io(self.store.info, oid)
         if info is None:
             return None
         name, size = info
@@ -635,14 +647,19 @@ class Raylet:
         """Remote raylet pull (data-plane; single frame, small objects)."""
         if not self.store.contains(oid):
             return None
-        return self.store.read_bytes(oid)
+        try:
+            return await self._store_io(self.store.read_bytes, oid)
+        except KeyError:
+            # Evicted since contains(), or a spilled copy failed to
+            # restore: "no longer a holder", the puller tries elsewhere.
+            return None
 
     async def handle_object_meta(self, conn: ServerConnection, *,
                                  oid: str) -> Optional[Dict[str, int]]:
-        info = self.store.info(oid)
-        if info is None:
+        size = self.store.size_of(oid)
+        if size is None:
             return None
-        return {"size": info[1]}
+        return {"size": size}
 
     async def handle_read_object_chunk(self, conn: ServerConnection, *,
                                        oid: str, offset: int,
@@ -651,7 +668,11 @@ class Raylet:
         chunked transfer). Returns None if the object vanished."""
         if not self.store.contains(oid):
             return None
-        return self.store.read_range(oid, offset, length)
+        try:
+            return await self._store_io(
+                self.store.read_range, oid, offset, length)
+        except KeyError:
+            return None
 
     # Large objects stream in 1 MiB frames so a multi-GB transfer neither
     # doubles peak memory nor monopolizes either event loop.
@@ -668,12 +689,12 @@ class Raylet:
             data = await remote.call("read_object", oid=oid, timeout=60.0)
             if data is None:
                 return False
-            self.store.put_bytes(oid, data)
+            await self._store_io(self.store.put_bytes, oid, data)
             return True
         if self.store.contains(oid):
             return True
         try:
-            self.store.create(oid, size)
+            await self._store_io(self.store.create, oid, size)
         except FileExistsError:
             # A concurrent pull sealed it between contains() and here.
             return self.store.contains(oid)
@@ -684,7 +705,8 @@ class Raylet:
                     length=self.TRANSFER_CHUNK, timeout=60.0)
                 if chunk is None:
                     raise KeyError(f"{oid[:8]} evicted mid-transfer")
-                self.store.write_range(oid, offset, chunk)
+                await self._store_io(
+                    self.store.write_range, oid, offset, chunk)
             self.store.seal(oid)
         except BaseException:
             # Only roll back an entry WE still own unsealed — a
@@ -697,12 +719,18 @@ class Raylet:
 
     async def handle_put_object(self, conn: ServerConnection, *,
                                 oid: str, data: bytes) -> bool:
-        self.store.put_bytes(oid, data)
+        await self._store_io(self.store.put_bytes, oid, data)
         return True
 
     async def handle_delete_objects(self, conn: ServerConnection, *,
                                     oids: List[str]) -> int:
-        return sum(1 for oid in oids if self.store.delete(oid))
+        # Off-loop: native erase() waits out any in-flight restore's
+        # disk read before removing the entry.
+        n = 0
+        for oid in oids:
+            if await self._store_io(self.store.delete, oid):
+                n += 1
+        return n
 
     async def on_client_disconnect(self, conn: ServerConnection) -> None:
         """Drop queued lease requests from a vanished client so a later
@@ -726,7 +754,7 @@ class Raylet:
         deadline = (None if pull_timeout is None
                     else time.monotonic() + pull_timeout)
         while deadline is None or time.monotonic() < deadline:
-            info = self.store.info(oid)
+            info = await self._store_io(self.store.info, oid)
             if info is not None:
                 return {"shm_name": info[0], "size": info[1]}
             if owner_address:
@@ -768,8 +796,10 @@ class Raylet:
                                 pass
                         continue
                     if fetched:
-                        info = self.store.info(oid)
-                        return {"shm_name": info[0], "size": info[1]}
+                        info = await self._store_io(self.store.info, oid)
+                        if info is not None:
+                            return {"shm_name": info[0], "size": info[1]}
+                        continue  # evicted between pull and info: re-resolve
                     # The node answered but no longer holds the object
                     # (LRU-evicted/deleted): tell the owner to prune this
                     # stale location so future pulls skip it.
