@@ -5,7 +5,10 @@ LearnerGroup / EnvRunner / Algorithm); the old RolloutWorker/Policy stack
 and the torch/tf paths are intentionally not reproduced (SURVEY §7.9).
 """
 
+from ray_tpu.rllib.algorithms.impala import (APPO, APPOConfig, IMPALA,
+                                             IMPALAConfig)
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.core.impala_learner import ImpalaLearner
 from ray_tpu.rllib.core.learner import PPOLearner
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
@@ -14,5 +17,6 @@ from ray_tpu.rllib.env.env_runner import (SingleAgentEnvRunner,
 
 __all__ = [
     "PPO", "PPOConfig", "PPOLearner", "LearnerGroup",
+    "IMPALA", "IMPALAConfig", "APPO", "APPOConfig", "ImpalaLearner",
     "DiscreteMLPModule", "SingleAgentEnvRunner", "compute_gae",
 ]

@@ -105,6 +105,10 @@ class SingleAgentEnvRunner:
             "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
             "dones": done_buf, "logp_old": logp_buf, "values": val_buf,
             "last_values": np.asarray(last_values),
+            # The raw post-fragment observation: off-policy learners
+            # (IMPALA v-trace) bootstrap from the LEARNER's value of this
+            # state, not the actor's stale `last_values`.
+            "final_obs": self.obs.astype(np.float32),
             "trunc_values": trunc_values,
             "episode_returns": np.array(list(self._completed)),
         }

@@ -6,7 +6,8 @@ through the same session machinery Train uses.
 """
 
 from ray_tpu.air import session as _session
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     PBTScheduler)
 from ray_tpu.tune.search import (choice, grid_search, loguniform, randint,
                                  uniform)
 from ray_tpu.tune.trial import Trial
@@ -18,7 +19,7 @@ get_checkpoint = _session.get_checkpoint
 
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "Trial",
-    "ASHAScheduler", "FIFOScheduler",
+    "ASHAScheduler", "FIFOScheduler", "PBTScheduler",
     "grid_search", "choice", "uniform", "loguniform", "randint",
     "report", "get_checkpoint",
 ]

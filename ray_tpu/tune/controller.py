@@ -146,6 +146,10 @@ class TuneController:
             logger.info("trial %s stopped by scheduler at iter %d",
                         trial.trial_id, trial.iterations)
             self._stop_trial(trial, TERMINATED)
+        elif decision == TrialScheduler.RESTART:
+            logger.info("trial %s restarting with mutated config %s (PBT "
+                        "exploit)", trial.trial_id, trial.config)
+            self._restart_trial(trial)
         else:
             self._poll(trial)
 
@@ -161,13 +165,40 @@ class TuneController:
         trial.error = str(exc)
         self._teardown_actor(trial)
 
+    def _restart_trial(self, trial: Trial) -> None:
+        """PBT exploit: the scheduler swapped trial.config /
+        trial.checkpoint_dir in place; relaunch the trial from there.
+        The adopted checkpoint is copied under the trial's own dir first
+        — the donor keeps pruning its old checkpoints and must not be
+        able to delete the one we resume from."""
+        src = trial.checkpoint_dir
+        if src and os.path.isdir(src) and not src.startswith(
+                os.path.join(self.exp_dir, trial.trial_id) + os.sep):
+            import shutil
+
+            dst = os.path.join(self.exp_dir, trial.trial_id,
+                               f"exploit_{trial.iterations:06d}")
+            shutil.rmtree(dst, ignore_errors=True)
+            shutil.copytree(src, dst)
+            trial.checkpoint_dir = dst
+        self._detach_and_drain(trial)
+        trial.status = PENDING  # _launch_pending relaunches next loop
+
     def _stop_trial(self, trial: Trial, status: str) -> None:
-        actor = self._actors.pop(trial.trial_id, None)
         trial.status = status
         self.scheduler.on_trial_complete(trial, trial.last_result)
+        self._detach_and_drain(trial)
+
+    def _detach(self, trial: Trial):
+        """Unregister the trial's actor and drop its in-flight refs."""
+        actor = self._actors.pop(trial.trial_id, None)
         for ref, t in list(self._inflight.items()):
             if t is trial:
                 del self._inflight[ref]
+        return actor
+
+    def _detach_and_drain(self, trial: Trial) -> None:
+        actor = self._detach(trial)
         if actor is None:
             return
 
@@ -199,15 +230,12 @@ class TuneController:
                          name=f"stop-{trial.trial_id}").start()
 
     def _teardown_actor(self, trial: Trial) -> None:
-        actor = self._actors.pop(trial.trial_id, None)
+        actor = self._detach(trial)
         if actor is not None:
             try:
                 ray_tpu.kill(actor)
             except Exception:
                 pass
-        for ref, t in list(self._inflight.items()):
-            if t is trial:
-                del self._inflight[ref]
 
     def _cleanup(self, keep_status: bool = False) -> None:
         for trial in self.trials:
@@ -231,8 +259,13 @@ class TuneController:
         # Resume only ever needs the latest; prune older copies.
         import shutil
 
-        kept = sorted(d for d in os.listdir(trial_dir)
-                      if d.startswith("checkpoint_"))
+        # Prune by age so PBT's `exploit_*` copies age out with the
+        # regular `checkpoint_*` dirs (lexicographic order would
+        # interleave the two prefixes wrongly).
+        kept = sorted(
+            (d for d in os.listdir(trial_dir)
+             if d.startswith(("checkpoint_", "exploit_"))),
+            key=lambda d: os.path.getmtime(os.path.join(trial_dir, d)))
         for d in kept[:-2]:
             shutil.rmtree(os.path.join(trial_dir, d), ignore_errors=True)
         return path

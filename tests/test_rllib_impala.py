@@ -1,0 +1,175 @@
+"""IMPALA/APPO (framework=jax): v-trace math + the async pipeline.
+
+Reference coverage class: `rllib/algorithms/impala/tests/` (vtrace tests)
++ the async sampling semantics of `impala.py:692`. BASELINE north-star #3
+(async rollout actors feeding a learner group).
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=6, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _np_vtrace(values, bootstrap, rewards, nonterm, rhos, gamma,
+               rho_clip, c_clip):
+    """Straight-from-the-paper numpy reference (Espeholt et al. 2018)."""
+    T, B = rewards.shape
+    clipped = np.minimum(rho_clip, rhos)
+    cs = np.minimum(c_clip, rhos)
+    values_tp1 = np.concatenate([values[1:], bootstrap[None]], 0)
+    deltas = clipped * (rewards + gamma * nonterm * values_tp1 - values)
+    vs = np.zeros((T, B), np.float64)
+    acc = np.zeros(B, np.float64)
+    for t in range(T - 1, -1, -1):
+        acc = deltas[t] + gamma * nonterm[t] * cs[t] * acc
+        vs[t] = values[t] + acc
+    vs_tp1 = np.concatenate([vs[1:], bootstrap[None]], 0)
+    pg_adv = clipped * (rewards + gamma * nonterm * vs_tp1 - values)
+    return vs, pg_adv
+
+
+def test_vtrace_matches_numpy_reference():
+    from ray_tpu.rllib.core.impala_learner import vtrace_returns
+
+    rng = np.random.default_rng(0)
+    T, B = 7, 3
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    nonterm = (rng.random((T, B)) > 0.2).astype(np.float32)
+    rhos = np.exp(rng.normal(scale=0.5, size=(T, B))).astype(np.float32)
+    vs, pg = vtrace_returns(values, bootstrap, rewards, nonterm, rhos,
+                            gamma=0.95, rho_clip=1.0, c_clip=1.0)
+    ref_vs, ref_pg = _np_vtrace(values, bootstrap, rewards, nonterm, rhos,
+                                0.95, 1.0, 1.0)
+    np.testing.assert_allclose(np.asarray(vs), ref_vs, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(pg), ref_pg, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_vtrace_on_policy_reduces_to_nstep_return():
+    """With rho == c == 1 and no terminations, vs_t is the n-step
+    bootstrapped return — the defining on-policy property."""
+    from ray_tpu.rllib.core.impala_learner import vtrace_returns
+
+    T, B, gamma = 5, 1, 0.9
+    rewards = np.ones((T, B), np.float32)
+    values = np.zeros((T, B), np.float32)
+    bootstrap = np.zeros((B,), np.float32)
+    nonterm = np.ones((T, B), np.float32)
+    rhos = np.ones((T, B), np.float32)
+    vs, _ = vtrace_returns(values, bootstrap, rewards, nonterm, rhos,
+                           gamma=gamma, rho_clip=1.0, c_clip=1.0)
+    expected = np.array(
+        [[sum(gamma ** k for k in range(T - t))] for t in range(T)]
+    ).reshape(T, B)
+    np.testing.assert_allclose(np.asarray(vs), expected, rtol=1e-5)
+
+
+def test_impala_learner_single_step_improves_objective():
+    """One v-trace step on a synthetic positive-advantage batch pushes
+    the policy toward the advantaged action."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core.impala_learner import ImpalaLearner
+    from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
+
+    module = DiscreteMLPModule(obs_dim=4, num_actions=2, hiddens=(16,))
+    learner = ImpalaLearner(module, {"lr": 5e-2, "seed": 0,
+                                     "entropy_coeff": 0.0})
+    rng = np.random.default_rng(0)
+    T, B = 8, 16
+    obs = rng.normal(size=(T, B, 4)).astype(np.float32)
+    batch = {
+        "obs": obs,
+        "actions": np.zeros((T, B), np.int32),   # always action 0
+        "logp_old": np.full((T, B), np.log(0.5), np.float32),
+        "rewards": np.ones((T, B), np.float32),  # action 0 rewarded
+        "dones": np.zeros((T, B), np.float32),
+        "final_obs": rng.normal(size=(B, 4)).astype(np.float32),
+    }
+
+    def p_action0(params):
+        logits, _ = module.apply(params, jnp.asarray(obs.reshape(-1, 4)))
+        return float(jnp.mean(jax.nn.softmax(logits)[:, 0]))
+
+    before = p_action0(learner.params)
+    for _ in range(5):
+        stats = learner.update(batch)
+    after = p_action0(learner.params)
+    assert np.isfinite(stats["total_loss"])
+    assert after > before + 0.05
+
+
+def test_impala_async_iteration_end_to_end(ray_cluster):
+    """The async pipeline: fragments land, learner steps, weights
+    broadcast — one train() iteration with sane metrics."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    algo = IMPALAConfig(num_env_runners=2, num_envs_per_runner=2,
+                        rollout_fragment_length=16,
+                        train_batch_fragments=2,
+                        updates_per_iteration=3,
+                        platform="cpu").build()
+    try:
+        m = algo.train()
+        assert m["training_iteration"] == 1
+        # 3 updates x 2 fragments x [T=16 x 2 envs] steps
+        assert m["num_env_steps_sampled_lifetime"] == 3 * 2 * 16 * 2
+        assert np.isfinite(m["learner/total_loss"])
+        assert m["env_steps_per_sec"] > 0
+    finally:
+        algo.stop()
+
+
+def test_appo_iteration_end_to_end(ray_cluster):
+    from ray_tpu.rllib import APPOConfig
+
+    algo = APPOConfig(num_env_runners=2, num_envs_per_runner=2,
+                      rollout_fragment_length=16,
+                      train_batch_fragments=2,
+                      updates_per_iteration=3,
+                      platform="cpu").build()
+    try:
+        m = algo.train()
+        assert m["training_iteration"] == 1
+        assert np.isfinite(m["learner/total_loss"])
+    finally:
+        algo.stop()
+
+
+@pytest.mark.slow
+def test_impala_cartpole_learns(ray_cluster):
+    """Async IMPALA learns CartPole-v1 (lower bar than PPO — v-trace
+    one-pass updates are less sample-efficient; the point is that the
+    async pipeline learns at all, reference: rllib learning tests)."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    algo = IMPALAConfig(num_env_runners=2, num_envs_per_runner=8,
+                        rollout_fragment_length=32,
+                        train_batch_fragments=2,
+                        updates_per_iteration=10,
+                        lr=5e-4, entropy_coeff=0.01,
+                        platform="cpu").build()
+    try:
+        best = 0.0
+        for _ in range(60):
+            m = algo.train()
+            best = max(best, m["episode_return_mean"])
+            if best >= 150:
+                break
+        assert best >= 150, f"IMPALA failed to learn: best={best}"
+    finally:
+        algo.stop()
