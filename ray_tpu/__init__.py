@@ -27,6 +27,17 @@ from ray_tpu.core.worker import (
     wait,
 )
 from ray_tpu.runtime_context import get_runtime_context
+
+
+def _private_node():
+    """The head driver's owned process supervisor (gcs/raylet/dashboard
+    child processes), or None when connected to an existing cluster.
+    Test/CLI-facing (reference: ray._private.worker.global_worker.node)."""
+    from ray_tpu.core.worker import current_runtime
+
+    return getattr(current_runtime(or_none=True), "_node", None)
+
+
 from ray_tpu import exceptions
 from ray_tpu import util
 

@@ -210,6 +210,25 @@ class ClusterRuntime:
         self.address = self._server.address
         self._event_flusher = asyncio.ensure_future(
             self._flush_task_events_loop())
+        self._start_metrics_push()
+
+    def _start_metrics_push(self) -> None:
+        """Flush this process's app metrics (`ray_tpu.util.metrics`) to
+        the node's raylet on the configured interval (reference: the
+        worker->metrics-agent export path)."""
+        from ray_tpu.core.config import ray_config
+        from ray_tpu.util.metrics import start_metrics_push
+
+        wid = (self.worker_id.hex() if self.worker_id is not None
+               else f"driver-{os.getpid()}")
+
+        def push(snapshot):
+            self._loop.run(self._raylet.call(
+                "report_metrics", worker_id=wid, snapshot=snapshot,
+                timeout=5.0))
+
+        start_metrics_push(
+            push, ray_config().metrics_report_interval_ms / 1000.0)
 
     # -- task events (reference: task_event_buffer.h flush loop) --------
     def _record_task_event(self, task_id: str, name: str, event: str,
@@ -301,6 +320,12 @@ class ClusterRuntime:
         if self._shutdown:
             return
         self._shutdown = True
+        try:
+            from ray_tpu.util.metrics import stop_metrics_push
+
+            stop_metrics_push()
+        except Exception:
+            pass
         try:
             if self.mode == "driver":
                 self._loop.run(self._gcs.mark_job_finished(

@@ -74,6 +74,7 @@ class NodeSupervisor:
         self.processes: Dict[str, subprocess.Popen] = {}
         self.gcs_address: Optional[str] = None
         self.raylet_address: Optional[str] = None
+        self.dashboard_address: Optional[str] = None
         self.node_id: Optional[str] = None
         atexit.register(self.stop)
 
@@ -81,8 +82,8 @@ class NodeSupervisor:
     @classmethod
     def start_head(cls, num_cpus=None, num_gpus=None, resources=None,
                    object_store_memory=None,
-                   session_root: str = "/tmp/ray_tpu_sessions"
-                   ) -> "NodeSupervisor":
+                   session_root: str = "/tmp/ray_tpu_sessions",
+                   include_dashboard: bool = True) -> "NodeSupervisor":
         session_dir = os.path.join(
             session_root, f"session_{time.strftime('%Y%m%d-%H%M%S')}_"
                           f"{os.getpid()}")
@@ -91,6 +92,8 @@ class NodeSupervisor:
         node._start_raylet(
             detect_node_resources(num_cpus, num_gpus, resources),
             object_store_memory, is_head=True)
+        if include_dashboard:
+            node._start_dashboard()
         return node
 
     def _child_env(self) -> dict:
@@ -136,6 +139,18 @@ class NodeSupervisor:
             cmd += ["--head"]
         self.raylet_address = self._spawn(
             "raylet", cmd, r"RAYLET_ADDRESS=(\S+)")
+
+    def _start_dashboard(self) -> None:
+        """Observability HTTP head (reference: dashboard/head.py). A
+        dashboard failure must never block cluster bring-up."""
+        try:
+            self.dashboard_address = self._spawn(
+                "dashboard",
+                [sys.executable, "-m", "ray_tpu.dashboard",
+                 "--gcs", self.gcs_address],
+                r"DASHBOARD_READY (\S+)")
+        except Exception:
+            self.dashboard_address = None
 
     def stop(self) -> None:
         for name, proc in reversed(list(self.processes.items())):

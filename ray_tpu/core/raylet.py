@@ -107,6 +107,8 @@ class Raylet:
         self._worker_clients: Dict[str, RpcClient] = {}
         self._tasks: List[asyncio.Task] = []
         self._monitors: Dict[str, asyncio.Task] = {}
+        # worker_id -> (monotonic push time, app-metric snapshot)
+        self._worker_metrics: Dict[str, tuple] = {}
 
     @property
     def address(self) -> str:
@@ -363,6 +365,58 @@ class Raylet:
 
     def _feasible_locally(self, demand: Dict[str, float]) -> bool:
         return self._fits(self.resources_total, demand)
+
+    # ------------------------------------------------------------------
+    # metrics (reference: stats/metric_defs.h runtime metrics + the
+    # per-node metrics agent, _private/metrics_agent.py)
+    # ------------------------------------------------------------------
+    async def handle_report_metrics(self, conn: ServerConnection, *,
+                                    worker_id: str, snapshot: list) -> bool:
+        """A worker/driver process pushes its app-metric snapshot."""
+        self._worker_metrics[worker_id] = (time.monotonic(), snapshot)
+        return True
+
+    async def handle_get_metrics(self, conn: ServerConnection) -> list:
+        """Node-wide snapshot: raylet runtime gauges + every live
+        process's pushed app metrics (dashboard /metrics scrapes this)."""
+        stats = self.store.stats()
+        runtime = [{
+            "name": f"ray_tpu_{key}", "type": "gauge", "help": help_,
+            "samples": [{"tags": {}, "value": float(value)}],
+        } for key, value, help_ in [
+            ("object_store_used_bytes", stats.get("used", 0),
+             "Bytes resident in the node object store"),
+            ("object_store_capacity_bytes", stats.get("capacity", 0),
+             "Node object store capacity"),
+            ("object_store_num_objects", stats.get("num_objects", 0),
+             "Objects tracked by the node store"),
+            ("object_store_num_spilled", stats.get("num_spilled", 0),
+             "Objects currently spilled to disk"),
+            ("raylet_workers", len(self._workers), "Worker processes"),
+            ("raylet_idle_workers", len(self._idle),
+             "Idle cached workers"),
+            ("raylet_pending_leases", len(self._pending),
+             "Queued lease requests"),
+        ]]
+        for res, avail in self.resources_available.items():
+            runtime.append({
+                "name": "ray_tpu_resource_available", "type": "gauge",
+                "help": "Schedulable resource availability",
+                "samples": [{"tags": {"resource": res},
+                             "value": float(avail)}]})
+        from ray_tpu.util.metrics import merge_snapshots
+
+        # Stale = missed ~3 push intervals (dead worker); prune, don't
+        # just filter, so churned workers can't grow memory unboundedly.
+        cutoff = time.monotonic() - max(
+            60.0, 3 * ray_config().metrics_report_interval_ms / 1000.0)
+        for wid, (ts, _) in list(self._worker_metrics.items()):
+            if ts < cutoff:
+                del self._worker_metrics[wid]
+        per_source = [({"node_id": self.node_id[:8]}, runtime)] + [
+            ({"node_id": self.node_id[:8], "worker_id": wid[:8]}, snap)
+            for wid, (ts, snap) in self._worker_metrics.items()]
+        return merge_snapshots(per_source)
 
     async def handle_object_store_stats(self, conn: ServerConnection
                                         ) -> Dict[str, Any]:

@@ -1,0 +1,251 @@
+"""Dashboard head: the cluster's HTTP observability endpoint (API-first).
+
+Reference equivalent: `dashboard/head.py:81` (DashboardHead) +
+`dashboard/state_aggregator.py` + the metrics agent's Prometheus export
+(`python/ray/_private/metrics_agent.py:416`). The reference ships a React
+frontend; here the surface is the JSON API the frontend would consume,
+plus `/metrics` in Prometheus text format aggregating every node —
+SURVEY §7.11 ("dashboard (API-first, UI later)").
+
+Endpoints:
+  GET /api/nodes               cluster membership + resources
+  GET /api/actors              GCS actor table
+  GET /api/jobs                GCS job table
+  GET /api/placement_groups    GCS PG table
+  GET /api/objects             per-node object-store inventories
+  GET /api/cluster_status      resource totals/availability summary
+  GET /api/tasks?job_id=...    task events
+  GET /metrics                 Prometheus text: all nodes + app metrics
+  GET /                        tiny HTML index
+
+Started by `Node.start_head` (flag `dashboard=True`) as
+`python -m ray_tpu.dashboard --gcs <addr>`; the bound address registers
+in GCS KV under `dashboard_address` for discovery.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+_INDEX_HTML = """<!doctype html>
+<title>ray_tpu dashboard</title>
+<h1>ray_tpu dashboard</h1>
+<ul>
+<li><a href=/api/nodes>nodes</a>
+<li><a href=/api/actors>actors</a>
+<li><a href=/api/jobs>jobs</a>
+<li><a href=/api/placement_groups>placement groups</a>
+<li><a href=/api/objects>objects</a>
+<li><a href=/api/cluster_status>cluster status</a>
+<li><a href=/metrics>metrics (prometheus)</a>
+</ul>
+"""
+
+
+class DashboardHead:
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.gcs_address = gcs_address
+        self.host = host
+        self.port = port
+        self._gcs = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._raylet_clients: Dict[str, Any] = {}
+
+    async def start(self) -> int:
+        from ray_tpu.core.gcs.client import GcsClient
+
+        self._gcs = GcsClient(self.gcs_address)
+        await self._gcs.connect()
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        await self._gcs.kv_put(
+            b"dashboard_address",
+            f"{self.host}:{self.port}".encode(), overwrite=True)
+        logger.info("dashboard listening on %s:%s", self.host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for client in self._raylet_clients.values():
+            await client.close()
+        if self._gcs is not None:
+            await self._gcs.close()
+
+    # -- HTTP plumbing (same minimal HTTP/1.1 server as serve's proxy) --
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            request_line = await reader.readline()
+            parts = request_line.decode("latin1").split()
+            if len(parts) < 2:
+                return
+            method, target = parts[0], parts[1]
+            while True:  # drain headers
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            status, ctype, body = await self._route(method, target)
+            payload = body if isinstance(body, bytes) else body.encode()
+            writer.write(
+                f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + payload)
+            await writer.drain()
+        except Exception:
+            logger.debug("dashboard request failed", exc_info=True)
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _route(self, method: str, target: str):
+        from urllib.parse import parse_qs, urlparse
+
+        if method != "GET":
+            return "405 Method Not Allowed", "text/plain", "GET only\n"
+        parsed = urlparse(target)
+        path = parsed.path.rstrip("/") or "/"
+        try:
+            if path == "/":
+                return "200 OK", "text/html", _INDEX_HTML
+            if path == "/metrics":
+                return ("200 OK", "text/plain; version=0.0.4",
+                        await self._metrics())
+            if path.startswith("/api/"):
+                data = await self._api(path[len("/api/"):],
+                                       parse_qs(parsed.query))
+                if data is None:
+                    return "404 Not Found", "text/plain", "unknown API\n"
+                return ("200 OK", "application/json",
+                        json.dumps(data, default=str))
+            return "404 Not Found", "text/plain", "not found\n"
+        except Exception as exc:  # noqa: BLE001
+            logger.warning("dashboard handler error for %s", path,
+                           exc_info=True)
+            return ("500 Internal Server Error", "application/json",
+                    json.dumps({"error": str(exc)}))
+
+    # -- data sources ---------------------------------------------------
+    async def _api(self, endpoint: str, query: Dict[str, list]):
+        if endpoint == "nodes":
+            return await self._gcs.get_nodes()
+        if endpoint == "actors":
+            return await self._gcs.list_actors()
+        if endpoint == "jobs":
+            return await self._gcs.list_jobs()
+        if endpoint == "placement_groups":
+            return await self._gcs.list_placement_groups()
+        if endpoint == "objects":
+            return await self._per_node("object_store_stats")
+        if endpoint == "cluster_status":
+            return await self._cluster_status()
+        if endpoint == "tasks":
+            job = query.get("job_id", [None])[0]
+            return await self._gcs.get_task_events(job_id=job)
+        return None
+
+    async def _raylet(self, address: str):
+        from ray_tpu.core.rpc import RpcClient
+
+        client = self._raylet_clients.get(address)
+        if client is None:
+            client = RpcClient(address)
+            await client.connect()
+            self._raylet_clients[address] = client
+        return client
+
+    async def _drop_raylet(self, address: str) -> None:
+        """Evict a (presumed dead) cached client so the next request
+        reconnects instead of failing forever on a stale connection."""
+        client = self._raylet_clients.pop(address, None)
+        if client is not None:
+            try:
+                await client.close()
+            except Exception:
+                pass
+
+    async def _per_node(self, rpc: str, **kwargs) -> list:
+        out = []
+        for node in await self._gcs.get_nodes():
+            if not node.get("alive", True):
+                continue
+            try:
+                client = await self._raylet(node["address"])
+                out.append(await client.call(rpc, timeout=10.0, **kwargs))
+            except Exception as exc:  # noqa: BLE001
+                await self._drop_raylet(node["address"])
+                out.append({"node_id": node.get("node_id"),
+                            "error": str(exc)})
+        return out
+
+    async def _cluster_status(self) -> Dict[str, Any]:
+        nodes = await self._gcs.get_nodes()
+        totals: Dict[str, float] = {}
+        available: Dict[str, float] = {}
+        alive = 0
+        for n in nodes:
+            if not n.get("alive", True):
+                continue
+            alive += 1
+            for k, v in (n.get("resources_total") or {}).items():
+                totals[k] = totals.get(k, 0.0) + v
+            for k, v in (n.get("resources_available") or {}).items():
+                available[k] = available.get(k, 0.0) + v
+        return {"nodes_alive": alive, "nodes_total": len(nodes),
+                "resources_total": totals,
+                "resources_available": available}
+
+    async def _metrics(self) -> str:
+        from ray_tpu.util.metrics import merge_snapshots, render_prometheus
+
+        per_node = []
+        for node in await self._gcs.get_nodes():
+            if not node.get("alive", True):
+                continue
+            try:
+                client = await self._raylet(node["address"])
+                per_node.append(
+                    ({}, await client.call("get_metrics", timeout=10.0)))
+            except Exception as exc:  # noqa: BLE001
+                await self._drop_raylet(node["address"])
+                logger.debug("metrics scrape of %s failed: %s",
+                             node.get("node_id", "?")[:8], exc)
+        if not per_node:
+            return "# no nodes reporting\n"
+        # Single render over the merged snapshots: one HELP/TYPE header
+        # per metric name (duplicate headers break Prometheus parsers).
+        return render_prometheus(merge_snapshots(per_node))
+
+
+async def _amain(gcs: str, host: str, port: int) -> None:
+    head = DashboardHead(gcs, host, port)
+    await head.start()
+    print(f"DASHBOARD_READY {head.host}:{head.port}", flush=True)
+    await asyncio.Event().wait()
+
+
+def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs", required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(_amain(args.gcs, args.host, args.port))
+
+
+if __name__ == "__main__":
+    main()

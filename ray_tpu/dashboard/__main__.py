@@ -1,0 +1,3 @@
+from ray_tpu.dashboard import main
+
+main()

@@ -5,6 +5,7 @@ actor pools, state API).
 """
 
 from ray_tpu.util import collective  # noqa: F401
+from ray_tpu.util import metrics  # noqa: F401
 from ray_tpu.util.device_arrays import get_to_device, to_jax  # noqa: F401
 from ray_tpu.util.placement_group import (  # noqa: F401
     PlacementGroup, get_current_placement_group, placement_group,
