@@ -223,9 +223,11 @@ class ClusterRuntime:
                else f"driver-{os.getpid()}")
 
         def push(snapshot):
+            # Outer timeout bounds the push thread even when shutdown
+            # halts the event loop mid-call (no future to resolve).
             self._loop.run(self._raylet.call(
                 "report_metrics", worker_id=wid, snapshot=snapshot,
-                timeout=5.0))
+                timeout=5.0), timeout=10.0)
 
         start_metrics_push(
             push, ray_config().metrics_report_interval_ms / 1000.0)

@@ -6,14 +6,104 @@ InternalKV accessors) + `python/ray/_raylet.pyx:2473 GcsClient`.
 
 from __future__ import annotations
 
+import asyncio
+import logging
 from typing import Any, Callable, Dict, List, Optional
 
-from ray_tpu.core.rpc import RpcClient
+from ray_tpu.core.rpc import ConnectionLost, RpcClient
+
+logger = logging.getLogger(__name__)
+
+
+class _ReconnectingRpc:
+    """RpcClient facade that survives a GCS restart (reference: GCS
+    fault tolerance — workers/raylets reconnect against the restarted
+    server, `gcs_client` retry machinery + `redis_store_client.h`
+    persistence on the server side).
+
+    On ConnectionLost: reconnect to the same address within the
+    `gcs_rpc_timeout_s` window, re-attach push handlers, re-issue
+    channel subscriptions, then retry the call once. GCS table ops are
+    keyed/overwriting (idempotent), so a single retry is safe."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._client = RpcClient(address)
+        self._push_handlers: Dict[str, Callable] = {}
+        self._subscribed: set = set()
+        self._reconnect_lock: Optional[asyncio.Lock] = None
+        self._closed = False
+
+    @property
+    def connected(self) -> bool:
+        return self._client.connected
+
+    async def connect(self, timeout: float = 10.0) -> None:
+        self._reconnect_lock = asyncio.Lock()
+        await self._client.connect(timeout=timeout)
+
+    async def close(self) -> None:
+        self._closed = True
+        await self._client.close()
+
+    def on_push(self, channel: str, handler: Callable) -> None:
+        self._push_handlers[channel] = handler
+        self._client.on_push(channel, handler)
+
+    def mark_subscribed(self, channel: str) -> None:
+        self._subscribed.add(channel)
+
+    async def call(self, method: str, **kwargs: Any) -> Any:
+        try:
+            return await self._client.call(method, **kwargs)
+        except ConnectionLost:
+            if self._closed:
+                raise
+            await self._reconnect()
+            return await self._client.call(method, **kwargs)
+
+    async def _reconnect(self) -> None:
+        from ray_tpu.core.config import ray_config
+
+        async with self._reconnect_lock:
+            if self._client.connected:
+                return  # another caller already reconnected
+            loop = asyncio.get_running_loop()
+            window = ray_config().gcs_rpc_timeout_s
+            deadline = loop.time() + window
+            last_err: Optional[Exception] = None
+            while loop.time() < deadline:
+                fresh = RpcClient(self.address)
+                try:
+                    await fresh.connect(
+                        timeout=min(5.0, max(0.5,
+                                             deadline - loop.time())))
+                    for ch, h in self._push_handlers.items():
+                        fresh.on_push(ch, h)
+                    old, self._client = self._client, fresh
+                    try:
+                        await old.close()
+                    except Exception:
+                        pass
+                    for ch in self._subscribed:
+                        await fresh.call("subscribe", channel=ch)
+                    logger.info("reconnected to GCS at %s", self.address)
+                    return
+                except Exception as e:  # noqa: BLE001
+                    last_err = e
+                    try:
+                        await fresh.close()
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.5)
+            raise ConnectionLost(
+                f"GCS at {self.address} unreachable for {window}s: "
+                f"{last_err}")
 
 
 class GcsClient:
     def __init__(self, address: str):
-        self.rpc = RpcClient(address)
+        self.rpc = _ReconnectingRpc(address)
 
     async def connect(self, timeout: float = 10.0) -> None:
         await self.rpc.connect(timeout=timeout)
@@ -26,6 +116,7 @@ class GcsClient:
                         handler: Callable[[Any], Any]) -> None:
         self.rpc.on_push(channel, handler)
         await self.rpc.call("subscribe", channel=channel)
+        self.rpc.mark_subscribed(channel)
 
     async def publish(self, channel: str, data: Any) -> None:
         await self.rpc.call("publish", channel=channel, data=data)
@@ -36,10 +127,13 @@ class GcsClient:
 
     async def heartbeat(self, node_id: str,
                         resources_available: Dict[str, float],
-                        load: Optional[dict] = None) -> None:
-        await self.rpc.call("heartbeat", node_id=node_id,
-                            resources_available=resources_available,
-                            load=load, timeout=5.0)
+                        load: Optional[dict] = None) -> bool:
+        """False = the GCS does not recognize this node (it restarted or
+        declared the node dead): the caller must re-register."""
+        return await self.rpc.call(
+            "heartbeat", node_id=node_id,
+            resources_available=resources_available, load=load,
+            timeout=5.0)
 
     async def get_nodes(self) -> List[Dict[str, Any]]:
         return await self.rpc.call("get_nodes")

@@ -35,6 +35,7 @@ class GcsServer:
         self._storage_path = storage_path
         self._dirty = False
         self._snapshot_task: Optional[asyncio.Task] = None
+        self._flush_lock = asyncio.Lock()
         # -- tables (reference: gcs_table_storage.h) ----------------------
         self.nodes: Dict[str, Dict[str, Any]] = {}       # node_id hex -> info
         self.actors: Dict[str, Dict[str, Any]] = {}      # actor_id hex -> info
@@ -75,6 +76,22 @@ class GcsServer:
     def mark_dirty(self) -> None:
         self._dirty = True
 
+    async def flush_now(self) -> None:
+        """Write-through for registration-class mutations (named actors,
+        KV, jobs, PGs): the reference GCS acks only after the store
+        client persisted (redis_store_client.h), so a crash must not
+        lose an acked registration. High-churn updates (heartbeats,
+        actor state transitions) stay on the 1 Hz debounce."""
+        if not self._storage_path:
+            return
+        async with self._flush_lock:
+            self._dirty = False
+            try:
+                await asyncio.to_thread(self._write_snapshot)
+            except Exception:
+                self._dirty = True  # snapshot loop retries
+                logger.warning("GCS write-through failed", exc_info=True)
+
     def _load_storage(self) -> None:
         if not self._storage_path:
             return
@@ -104,16 +121,10 @@ class GcsServer:
             await asyncio.sleep(1.0)
             if not self._dirty:
                 continue
-            # Clear BEFORE the write: a mutation acked mid-write re-sets
-            # the flag and gets the next snapshot; clearing after would
-            # drop it. On failure re-set so the write retries (transient
-            # ENOSPC must not lose acked mutations).
-            self._dirty = False
-            try:
-                await asyncio.to_thread(self._write_snapshot)
-            except Exception:
-                self._dirty = True
-                logger.warning("GCS snapshot failed", exc_info=True)
+            # flush_now serializes every writer through _flush_lock —
+            # an unsynchronized periodic write could capture older tables
+            # yet rename over a newer write-through snapshot.
+            await self.flush_now()
 
     def _write_snapshot(self) -> None:
         import os
@@ -138,12 +149,9 @@ class GcsServer:
         if self._snapshot_task:
             self._snapshot_task.cancel()
         if self._storage_path and self._dirty:
-            # Final flush: acked mutations survive a clean shutdown.
-            try:
-                self._write_snapshot()
-                self._dirty = False
-            except Exception:
-                logger.warning("final GCS snapshot failed", exc_info=True)
+            # Final flush: acked mutations survive a clean shutdown
+            # (through the same lock as every other writer).
+            await self.flush_now()
         await self._rpc.stop()
 
     # ------------------------------------------------------------------
@@ -244,12 +252,17 @@ class GcsServer:
     async def handle_heartbeat(self, conn: ServerConnection, *, node_id: str,
                                resources_available: Dict[str, float],
                                load: Optional[Dict[str, Any]] = None) -> bool:
-        self._heartbeats[node_id] = time.time()
         info = self.nodes.get(node_id)
-        if info is not None:
-            info["resources_available"] = resources_available
-            if load is not None:
-                info["load"] = load
+        if info is None or not info.get("alive", False):
+            # Unknown (GCS restarted; nodes are not persisted) or
+            # previously declared dead: the raylet must re-register
+            # before its heartbeats count (GCS FT re-registration
+            # contract — raylet re-registers on a False reply).
+            return False
+        self._heartbeats[node_id] = time.time()
+        info["resources_available"] = resources_available
+        if load is not None:
+            info["load"] = load
         return True
 
     async def handle_get_nodes(self, conn: ServerConnection,
@@ -273,7 +286,9 @@ class GcsServer:
         if name:
             key = f"{ns}/{name}"
             existing = self.named_actors.get(key)
-            if existing is not None:
+            if existing == actor_id:
+                pass  # at-least-once retry of our own registration
+            elif existing is not None:
                 state = self.actors.get(existing, {}).get("state")
                 if state not in ("DEAD", None):
                     return {"ok": False,
@@ -284,6 +299,7 @@ class GcsServer:
                                                             "PENDING"))
         self.actors[actor_id] = info
         await self._publish(f"actor:{actor_id}", info)
+        await self.flush_now()  # ack implies durable (named) registration
         return {"ok": True}
 
     async def handle_update_actor(self, conn: ServerConnection, *,
@@ -378,8 +394,11 @@ class GcsServer:
         self.mark_dirty()
         k = key.decode() if isinstance(key, bytes) else key
         if not overwrite and k in self.kv:
-            return False
+            # Equal value => treat as an at-least-once retry of the put
+            # that already won (the client may never have seen the ack).
+            return self.kv[k] == value
         self.kv[k] = value
+        await self.flush_now()  # KV acks are durable (Serve state, etc.)
         return True
 
     async def handle_kv_get(self, conn: ServerConnection, *,
@@ -391,7 +410,9 @@ class GcsServer:
                             key: bytes) -> bool:
         self.mark_dirty()
         k = key.decode() if isinstance(key, bytes) else key
-        return self.kv.pop(k, None) is not None
+        existed = self.kv.pop(k, None) is not None
+        await self.flush_now()
+        return existed
 
     async def handle_kv_keys(self, conn: ServerConnection, *,
                              prefix: str) -> List[str]:

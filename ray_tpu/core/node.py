@@ -140,6 +140,25 @@ class NodeSupervisor:
         self.raylet_address = self._spawn(
             "raylet", cmd, r"RAYLET_ADDRESS=(\S+)")
 
+    def kill_gcs(self) -> None:
+        """Fault injection: hard-kill the GCS process (reference:
+        test_gcs_fault_tolerance.py)."""
+        proc = self.processes["gcs"]
+        proc.kill()
+        proc.wait()
+
+    def restart_gcs(self) -> None:
+        """Bring the GCS back at the SAME address with its persisted
+        storage; raylets re-register via the heartbeat False-reply
+        contract, clients reconnect via _ReconnectingRpc."""
+        host, port = self.gcs_address.rsplit(":", 1)
+        addr = self._spawn(
+            "gcs", [sys.executable, "-m", "ray_tpu.core.gcs.server",
+                    "--host", host, "--port", port, "--storage",
+                    os.path.join(self.session_dir, "gcs_storage.pkl")],
+            r"GCS_ADDRESS=(\S+)")
+        assert addr == self.gcs_address, (addr, self.gcs_address)
+
     def _start_dashboard(self) -> None:
         """Observability HTTP head (reference: dashboard/head.py). A
         dashboard failure must never block cluster bring-up."""

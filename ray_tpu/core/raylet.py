@@ -120,11 +120,7 @@ class Raylet:
     async def start(self) -> None:
         await self._rpc.start()
         await self._gcs.connect()
-        await self._gcs.register_node(
-            node_id=self.node_id, address=self.address,
-            object_store_address=self.address,
-            resources=self.resources_total, labels=self.labels,
-            is_head=self.is_head)
+        await self._register_with_gcs()
         await self._gcs.subscribe("node", self._on_node_update)
         await self._gcs.subscribe("job", self._on_job_update)
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
@@ -151,11 +147,18 @@ class Raylet:
         await self._rpc.stop()
         await self._gcs.close()
 
+    async def _register_with_gcs(self) -> None:
+        await self._gcs.register_node(
+            node_id=self.node_id, address=self.address,
+            object_store_address=self.address,
+            resources=self.resources_total, labels=self.labels,
+            is_head=self.is_head)
+
     async def _heartbeat_loop(self) -> None:
         period = ray_config().raylet_heartbeat_period_ms / 1000.0
         while True:
             try:
-                await self._gcs.heartbeat(
+                ok = await self._gcs.heartbeat(
                     self.node_id, self.resources_available,
                     load={"pending": len(self._pending),
                           # Demand shapes drive the autoscaler's
@@ -163,6 +166,13 @@ class Raylet:
                           # resource_load_by_shape).
                           "pending_demands": [dict(p.demand) for p in
                                               self._pending[:100]]})
+                if ok is False:
+                    # GCS restarted (nodes aren't persisted) or declared
+                    # us dead: re-register so scheduling resumes (GCS FT
+                    # re-registration contract).
+                    logger.info("GCS does not recognize this node; "
+                                "re-registering")
+                    await self._register_with_gcs()
                 self._cluster_view = {
                     n["node_id"]: n for n in await self._gcs.get_nodes()}
             except Exception:

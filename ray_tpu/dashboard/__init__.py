@@ -175,19 +175,22 @@ class DashboardHead:
             except Exception:
                 pass
 
+    async def _scrape_node(self, node: Dict[str, Any], rpc: str,
+                           **kwargs):
+        try:
+            client = await self._raylet(node["address"])
+            return await client.call(rpc, timeout=10.0, **kwargs)
+        except Exception as exc:  # noqa: BLE001
+            await self._drop_raylet(node["address"])
+            return {"node_id": node.get("node_id"), "error": str(exc)}
+
     async def _per_node(self, rpc: str, **kwargs) -> list:
-        out = []
-        for node in await self._gcs.get_nodes():
-            if not node.get("alive", True):
-                continue
-            try:
-                client = await self._raylet(node["address"])
-                out.append(await client.call(rpc, timeout=10.0, **kwargs))
-            except Exception as exc:  # noqa: BLE001
-                await self._drop_raylet(node["address"])
-                out.append({"node_id": node.get("node_id"),
-                            "error": str(exc)})
-        return out
+        # Concurrent fan-out: one hung node must not stall the endpoint
+        # for the healthy rest.
+        nodes = [n for n in await self._gcs.get_nodes()
+                 if n.get("alive", True)]
+        return list(await asyncio.gather(
+            *(self._scrape_node(n, rpc, **kwargs) for n in nodes)))
 
     async def _cluster_status(self) -> Dict[str, Any]:
         nodes = await self._gcs.get_nodes()
@@ -209,18 +212,9 @@ class DashboardHead:
     async def _metrics(self) -> str:
         from ray_tpu.util.metrics import merge_snapshots, render_prometheus
 
-        per_node = []
-        for node in await self._gcs.get_nodes():
-            if not node.get("alive", True):
-                continue
-            try:
-                client = await self._raylet(node["address"])
-                per_node.append(
-                    ({}, await client.call("get_metrics", timeout=10.0)))
-            except Exception as exc:  # noqa: BLE001
-                await self._drop_raylet(node["address"])
-                logger.debug("metrics scrape of %s failed: %s",
-                             node.get("node_id", "?")[:8], exc)
+        results = await self._per_node("get_metrics")
+        per_node = [({}, snaps) for snaps in results
+                    if isinstance(snaps, list)]  # dicts = scrape errors
         if not per_node:
             return "# no nodes reporting\n"
         # Single render over the merged snapshots: one HELP/TYPE header

@@ -37,7 +37,13 @@ class Metric:
         self.tag_keys = tuple(tag_keys or ())
         self._default_tags: Dict[str, str] = {}
         self._lock = threading.Lock()
-        (registry or default_registry()).register(self)
+        # Registration is deferred to _register_self(), called by each
+        # subclass AFTER its sample state exists: the push thread may
+        # snapshot the registry concurrently with construction.
+        self._registry = registry
+
+    def _register_self(self) -> None:
+        (self._registry or default_registry()).register(self)
 
     def set_default_tags(self, tags: Dict[str, str]) -> None:
         self._default_tags = dict(tags)
@@ -65,6 +71,7 @@ class Counter(Metric):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self._values: Dict[Tuple, float] = {}
+        self._register_self()
 
     def inc(self, value: float = 1.0,
             tags: Optional[Dict[str, str]] = None) -> None:
@@ -88,6 +95,7 @@ class Gauge(Metric):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self._values: Dict[Tuple, float] = {}
+        self._register_self()
 
     def set(self, value: float,
             tags: Optional[Dict[str, str]] = None) -> None:
@@ -119,6 +127,7 @@ class Histogram(Metric):
         super().__init__(name, description, tag_keys, registry)
         # per tag-set: [bucket_counts..., +Inf], sum, count
         self._state: Dict[Tuple, Dict[str, Any]] = {}
+        self._register_self()
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:
