@@ -210,7 +210,41 @@ class ClusterRuntime:
         self.address = self._server.address
         self._event_flusher = asyncio.ensure_future(
             self._flush_task_events_loop())
+        # Proactive location pruning: learn of node deaths from the GCS
+        # instead of waiting for a puller to trip over a stale location
+        # (reference: ownership-based object directory subscribes to
+        # node removal).
+        try:
+            await self._gcs.subscribe("node", self._on_node_event)
+        except Exception:
+            logger.warning("node-event subscription failed", exc_info=True)
         self._start_metrics_push()
+
+    async def _on_node_event(self, data: dict) -> None:
+        if not isinstance(data, dict) or data.get("alive", True):
+            return
+        node_id = data.get("node_id")
+        addr = data.get("address")
+        if not addr:
+            # Older event shape: resolve via the node table.
+            try:
+                for n in await self._gcs.get_nodes():
+                    if n.get("node_id") == node_id:
+                        addr = n.get("address")
+                        break
+            except Exception:
+                return
+        if not addr:
+            return
+        lost = []
+        with self._owned_lock:
+            for oid, entry in self._owned.items():
+                if addr in entry.nodes:
+                    entry.nodes = [n for n in entry.nodes if n != addr]
+                    if not entry.nodes and entry.is_stored:
+                        lost.append(oid)
+        for oid in lost:
+            self._trigger_reconstruction(oid)
 
     def _start_metrics_push(self) -> None:
         """Flush this process's app metrics (`ray_tpu.util.metrics`) to
@@ -426,7 +460,8 @@ class ClusterRuntime:
 
         self._shm.write(shm_name, write)
         self._loop.run(self._raylet.call("seal_object", oid=oid))
-        entry.nodes.append(self.raylet_address)
+        if self.raylet_address not in entry.nodes:
+            entry.nodes.append(self.raylet_address)
         entry.is_stored = True
         entry.fut.set_result(("node", self.raylet_address))
 
@@ -580,6 +615,15 @@ class ClusterRuntime:
             "fn_key": fn_key,
             "name": remote_function._function_name,
             "args": args_blob,
+            # TOP-LEVEL arg refs only, for pre-lease dependency
+            # resolution (reference: dependency_resolver.h — deps resolve
+            # BEFORE a worker is leased, so a blocked dependency never
+            # holds a worker slot hostage). Nested refs (inside
+            # lists/dicts) are pass-by-reference — the worker never
+            # fetches them, so submission must not block on them.
+            "arg_oids": [a.hex() for a in
+                         list(args) + list(kwargs.values())
+                         if isinstance(a, ObjectRef)],
             "num_returns": num_returns,
             "streaming": streaming,
             "owner": self.address,
@@ -650,6 +694,26 @@ class ClusterRuntime:
         for oid in pinned:
             self.remove_local_reference(oid)
 
+    async def _resolve_dependencies(self, spec: dict) -> None:
+        """Wait until every OWNED arg object exists (inline value or a
+        stored copy) before leasing a worker (reference:
+        dependency_resolver.h via direct_task_transport.cc:24). Without
+        this, a task whose upstream is being reconstructed occupies a
+        worker slot while it pulls — and a chain of such tasks can
+        starve the very re-executions that would unblock them
+        (chaos-suite deadlock). Borrowed refs (owned elsewhere) resolve
+        worker-side as before."""
+        for oid in spec.get("arg_oids", ()):
+            while True:
+                with self._owned_lock:
+                    entry = self._owned.get(oid)
+                    ready = entry is None or entry.fut.done()
+                if ready:
+                    break
+                # Poll: entry.fut can be REPLACED by a reconstruction
+                # reset, so awaiting one future instance would hang.
+                await asyncio.sleep(0.02)
+
     async def _submit_async(self, spec: dict, refs: List[ObjectRef],
                             pinned: Optional[List[ObjectID]] = None) -> None:
         retries = spec.get("max_retries", 0)
@@ -657,9 +721,18 @@ class ClusterRuntime:
         try:
             while True:
                 try:
+                    # (Re-)resolve on every attempt: a retry often means
+                    # a node died, taking this task's upstream objects
+                    # with it.
+                    await self._resolve_dependencies(spec)
                     await self._run_on_leased_worker(spec)
                     return
-                except (ConnectionLost, RpcError) as e:
+                except (ConnectionLost, RpcError, TimeoutError,
+                        asyncio.TimeoutError, OSError) as e:
+                    # TimeoutError/OSError cover leases stranded on a
+                    # node that died while the request was queued there
+                    # — transient cluster faults, retryable like a
+                    # dropped connection (chaos-suite finding).
                     if spec["task_id"] in self._cancel_requested:
                         # A force-cancel kills the worker mid-task; that
                         # must surface as cancellation, not retry.
@@ -675,7 +748,6 @@ class ClusterRuntime:
                                 spec["name"], attempt, e)
                     delay = ray_config().task_retry_delay_ms / 1000.0
                     if delay:
-                        import asyncio
                         await asyncio.sleep(delay)
                 except _TaskCancelledBeforePush:
                     self._fail_task_cancelled(spec, refs)
@@ -750,10 +822,17 @@ class ClusterRuntime:
 
     def _record_task_reply(self, spec: dict, reply: dict) -> None:
         task_id = spec["task_id"]
+        if logger.isEnabledFor(logging.DEBUG):
+            logger.debug("task reply %s (%s): %s", spec.get("name"),
+                         task_id[:12],
+                         [(r.get("oid", "")[:16], r.get("node"),
+                           ("inline" if r.get("inline") is not None
+                            else "-")) for r in reply.get("results", [])])
         for res in reply.get("results", []):
             entry = self._owned_entry(res["oid"])
             if res.get("node"):
-                entry.nodes.append(res["node"])
+                if res["node"] not in entry.nodes:
+                    entry.nodes.append(res["node"])
                 entry.is_stored = True
                 if not entry.fut.done():
                     entry.fut.set_result(("node", res["node"]))
@@ -849,14 +928,43 @@ class ClusterRuntime:
                              bundle: Optional[Tuple[str, int]] = None,
                              address: Optional[str] = None) -> dict:
         address = address or self.raylet_address
+        pinned_address = address is not None and address != \
+            self.raylet_address  # PG bundle leases stay on their node
         spillbacks = 0
+        request_id = uuid.uuid4().hex
         while True:
-            client = await self._raylet_client(address)
-            reply = await client.call(
-                "request_worker_lease", resources=resources,
-                is_actor=is_actor, spillback_count=spillbacks,
-                bundle=list(bundle) if bundle else None,
-                timeout=ray_config().worker_lease_timeout_ms / 1000.0)
+            try:
+                # Spillback targets get a short dial: a freshly-dead node
+                # (stale cluster view) must cost ~2s, not a full connect
+                # window per retry — fall back to the local raylet, whose
+                # view refreshes within the health-check period.
+                client = await self._raylet_client(
+                    address,
+                    connect_timeout=(10.0 if address == self.raylet_address
+                                     else 2.0))
+            except (ConnectionLost, OSError):
+                if pinned_address or address == self.raylet_address:
+                    raise
+                address = self.raylet_address
+                spillbacks += 1
+                continue
+            try:
+                reply = await client.call(
+                    "request_worker_lease", resources=resources,
+                    is_actor=is_actor, spillback_count=spillbacks,
+                    bundle=list(bundle) if bundle else None,
+                    request_id=request_id,
+                    timeout=ray_config().worker_lease_timeout_ms / 1000.0)
+            except (TimeoutError, asyncio.TimeoutError):
+                # Tell the raylet we gave up: drop the queued request, or
+                # return the worker if it was granted concurrently —
+                # otherwise every timeout+retry would leak one worker.
+                try:
+                    await client.call("cancel_lease_request",
+                                      request_id=request_id, timeout=5.0)
+                except Exception:
+                    pass
+                raise
             if reply.get("granted"):
                 info = reply["granted"]
                 info["raylet_address"] = address
@@ -884,11 +992,12 @@ class ClusterRuntime:
             pass
 
     # -- clients -------------------------------------------------------
-    async def _raylet_client(self, address: str) -> RpcClient:
+    async def _raylet_client(self, address: str,
+                             connect_timeout: float = 10.0) -> RpcClient:
         client = self._raylet_clients.get(address)
         if client is None or not client.connected:
             client = RpcClient(address)
-            await client.connect(timeout=10.0)
+            await client.connect(timeout=connect_timeout)
             self._raylet_clients[address] = client
         return client
 
@@ -995,8 +1104,20 @@ class ClusterRuntime:
             address, idx = await self._pg_location(
                 pg["pg_id"], pg["bundle_index"], demand=creation["demand"])
             bundle = (pg["pg_id"], idx)
-        worker = await self._request_lease(creation["demand"], is_actor=True,
-                                           bundle=bundle, address=address)
+        # Lease timeouts are transient (busy/recovering cluster): retry a
+        # few times before declaring the creation failed, like task
+        # submission does.
+        attempt = 0
+        while True:
+            try:
+                worker = await self._request_lease(
+                    creation["demand"], is_actor=True, bundle=bundle,
+                    address=address)
+                break
+            except (TimeoutError, asyncio.TimeoutError, OSError):
+                attempt += 1
+                if attempt > 3:
+                    raise
         client = await self._worker_client(worker["worker_address"])
         try:
             reply = await client.call(
@@ -1078,7 +1199,6 @@ class ClusterRuntime:
         if state is None or state.address is None or state.state != "ALIVE":
             # Borrowed handle or restarting actor: resolve via GCS, waiting
             # briefly for PENDING/RESTARTING actors to come up.
-            import asyncio
             deadline = time.monotonic() + 30.0
             while time.monotonic() < deadline:
                 info = await self._gcs.get_actor(actor_id=aid)
@@ -1137,7 +1257,6 @@ class ClusterRuntime:
             if state is not None:
                 state.state = "RESTARTING"
                 state.address = None
-                import asyncio
                 asyncio.ensure_future(self._maybe_restart_actor(state))
             self._fail_actor_task(
                 spec, refs,
@@ -1167,7 +1286,6 @@ class ClusterRuntime:
             state.state = "DEAD"
             self._unpin_actor(state)
             return False
-        import asyncio
         state.restart_inflight = True
         try:
             if state.restarts_remaining > 0:
@@ -1344,7 +1462,6 @@ class ClusterRuntime:
         return pg_id
 
     async def _schedule_pg_async(self, pg_id: str, info: dict) -> None:
-        import asyncio
 
         from ray_tpu.core.pg_scheduler import select_pg_nodes
 
@@ -1465,7 +1582,6 @@ class ClusterRuntime:
         waiting for a still-scheduling group. bundle_index -1 → round-robin
         over the bundles whose spec can hold `demand` (reference:
         any-feasible-bundle semantics)."""
-        import asyncio
 
         info = self._pg_cache.get(pg_id)
         if info is None or info.get("state") != "CREATED":
@@ -1543,7 +1659,8 @@ class ClusterRuntime:
                                     node: Optional[str] = None) -> bool:
         entry = self._owned_entry(oid)
         if node:
-            entry.nodes.append(node)
+            if node not in entry.nodes:
+                entry.nodes.append(node)
             entry.is_stored = True
             if not entry.fut.done():
                 entry.fut.set_result(("node", node))
@@ -1565,7 +1682,7 @@ class ClusterRuntime:
         with self._owned_lock:
             entry = self._owned.get(oid)
             if entry is not None and node in entry.nodes:
-                entry.nodes.remove(node)
+                entry.nodes = [n for n in entry.nodes if n != node]
                 lost = not entry.nodes and entry.is_stored
         if lost:
             self._trigger_reconstruction(oid)
@@ -1605,8 +1722,21 @@ class ClusterRuntime:
         async def _resubmit():
             try:
                 await self._submit_async(rec["spec"], refs, None)
+            except BaseException as e:  # noqa: BLE001
+                logger.warning("reconstruction resubmit for %s aborted: "
+                               "%r", oid[:16], e)
+                raise
             finally:
                 rec["inflight"] = False
+                if logger.isEnabledFor(logging.DEBUG):
+                    with self._owned_lock:
+                        e = self._owned.get(oid)
+                        logger.debug(
+                            "reconstruction resubmit finished for %s: "
+                            "done=%s nodes=%s stored=%s", oid[:16],
+                            e is not None and e.fut.done(),
+                            e.nodes if e else None,
+                            e.is_stored if e else None)
 
         self._loop.spawn(_resubmit())
         return True
@@ -1699,6 +1829,7 @@ class ClusterRuntime:
                                             value)
             ok = True
         except BaseException as e:  # noqa: BLE001
+            self._die_if_orphaned()
             results = self._package_error(task_id, num_returns, name, e)
         finally:
             self._running_task_threads.pop(task_id, None)
@@ -1727,6 +1858,20 @@ class ClusterRuntime:
         return [self._package_result(oid_for(i), v)
                 for i, v in enumerate(value)]
 
+    def _die_if_orphaned(self) -> None:
+        """A worker whose raylet died is a zombie: its object store, lease
+        and chip bookkeeping are gone. Reporting the resulting plumbing
+        errors (ConnectionLost on arg fetch / result store) to the owner
+        would surface them as USER task failures, which don't retry.
+        Exit instead — the owner observes worker death as a SYSTEM
+        failure and retries/reconstructs (reference: workers exit on
+        raylet socket EOF, node_manager.cc disconnect handling)."""
+        if self.mode == "worker" and not self._raylet.connected:
+            logging.getLogger(__name__).warning(
+                "raylet connection lost mid-task; exiting so the owner "
+                "retries elsewhere")
+            os._exit(1)
+
     def _package_error(self, task_id: str, num_returns: int, name: str,
                        exc: BaseException) -> List[dict]:
         wrapped = (exc if isinstance(exc, (RayTaskError, RayActorError,
@@ -1741,8 +1886,12 @@ class ClusterRuntime:
 
     async def handle_push_task(self, conn: ServerConnection, *,
                                spec: dict) -> dict:
-        import asyncio
 
+        # Refuse work the moment our raylet is gone (don't wait to fail
+        # on the result store): the pusher holds a stale lease on a dead
+        # node; exiting here converts it to a clean worker-death retry
+        # without a wasted duplicate execution.
+        self._die_if_orphaned()
         if spec.get("streaming"):
             return await self._execute_streaming(spec, actor=False)
         loop = asyncio.get_running_loop()
@@ -1750,7 +1899,6 @@ class ClusterRuntime:
             self._exec_pool, self._execute_task, spec)
 
     async def _execute_streaming(self, spec: dict, actor: bool) -> dict:
-        import asyncio
 
         loop = asyncio.get_running_loop()
         owner_addr = spec["owner"]
@@ -1779,6 +1927,7 @@ class ClusterRuntime:
                     fut.result()
                 return None
             except BaseException as e:  # noqa: BLE001
+                self._die_if_orphaned()
                 wrapped = (e if isinstance(e, RayTaskError)
                            else RayTaskError.from_exception(
                                spec.get("name", "task"), e))
@@ -1817,7 +1966,6 @@ class ClusterRuntime:
                                 concurrency_groups: Optional[dict] = None,
                                 runtime_env: Optional[dict] = None
                                 ) -> dict:
-        import asyncio
         import inspect as _inspect
 
         loop = asyncio.get_running_loop()
@@ -1869,7 +2017,6 @@ class ClusterRuntime:
     def _execute_actor_method(self, spec: dict) -> dict:
         from ray_tpu.runtime_context import (_reset_task_context,
                                              _set_task_context)
-        import asyncio
         import inspect as _inspect
 
         task_id = spec["task_id"]
@@ -1911,6 +2058,7 @@ class ClusterRuntime:
                                             value)
             ok = True
         except BaseException as e:  # noqa: BLE001
+            self._die_if_orphaned()
             results = self._package_error(task_id, num_returns, name, e)
         finally:
             self._running_task_threads.pop(task_id, None)
@@ -2037,7 +2185,6 @@ class ClusterRuntime:
         asyncio.ensure_future(notify())
 
     async def handle_exit_worker(self, conn: ServerConnection) -> bool:
-        import asyncio
 
         async def _die():
             await asyncio.sleep(0.05)

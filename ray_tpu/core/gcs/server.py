@@ -36,6 +36,7 @@ class GcsServer:
         self._dirty = False
         self._snapshot_task: Optional[asyncio.Task] = None
         self._flush_lock = asyncio.Lock()
+        self._flush_gen = 0
         # -- tables (reference: gcs_table_storage.h) ----------------------
         self.nodes: Dict[str, Dict[str, Any]] = {}       # node_id hex -> info
         self.actors: Dict[str, Dict[str, Any]] = {}      # actor_id hex -> info
@@ -84,7 +85,14 @@ class GcsServer:
         actor state transitions) stay on the 1 Hz debounce."""
         if not self._storage_path:
             return
+        my_gen = self._flush_gen
         async with self._flush_lock:
+            if self._flush_gen > my_gen:
+                # A snapshot STARTED after this caller's mutation (and
+                # after it queued here) already captured it: coalesce
+                # instead of rewriting full state once per acked KV put.
+                return
+            self._flush_gen += 1
             self._dirty = False
             try:
                 await asyncio.to_thread(self._write_snapshot)
@@ -179,7 +187,9 @@ class GcsServer:
             return
         info["alive"] = False
         info["end_time"] = time.time()
-        await self._publish("node", {"node_id": node_id, "alive": False})
+        await self._publish("node", {
+            "node_id": node_id, "alive": False,
+            "address": (self.nodes.get(node_id) or {}).get("address")})
         # Fail actors that lived on the node.
         for actor_id, a in self.actors.items():
             if a.get("node_id") == node_id and a["state"] not in (
@@ -233,6 +243,11 @@ class GcsServer:
                                    resources: Dict[str, float],
                                    labels: Dict[str, str],
                                    is_head: bool = False) -> Dict[str, Any]:
+        # A node re-registering after WE declared it dead must be told:
+        # the cluster already restarted its actors and reconstructed its
+        # objects elsewhere, so its surviving actor workers are stale.
+        was_dead = (node_id in self.nodes
+                    and not self.nodes[node_id].get("alive", True))
         self.nodes[node_id] = {
             "node_id": node_id,
             "address": address,
@@ -247,7 +262,7 @@ class GcsServer:
         self._heartbeats[node_id] = time.time()
         conn.metadata["node_id"] = node_id
         await self._publish("node", {"node_id": node_id, "alive": True})
-        return {"ok": True}
+        return {"ok": True, "was_dead": was_dead}
 
     async def handle_heartbeat(self, conn: ServerConnection, *, node_id: str,
                                resources_available: Dict[str, float],
@@ -299,7 +314,11 @@ class GcsServer:
                                                             "PENDING"))
         self.actors[actor_id] = info
         await self._publish(f"actor:{actor_id}", info)
-        await self.flush_now()  # ack implies durable (named) registration
+        if name:
+            # Only NAMED registrations are looked up after a restart;
+            # anonymous actors ride the 1 Hz debounce (a full-table
+            # snapshot per short-lived actor would serialize creation).
+            await self.flush_now()
         return {"ok": True}
 
     async def handle_update_actor(self, conn: ServerConnection, *,
@@ -391,12 +410,12 @@ class GcsServer:
     # ------------------------------------------------------------------
     async def handle_kv_put(self, conn: ServerConnection, *, key: bytes,
                             value: bytes, overwrite: bool = True) -> bool:
-        self.mark_dirty()
         k = key.decode() if isinstance(key, bytes) else key
         if not overwrite and k in self.kv:
             # Equal value => treat as an at-least-once retry of the put
             # that already won (the client may never have seen the ack).
             return self.kv[k] == value
+        self.mark_dirty()
         self.kv[k] = value
         await self.flush_now()  # KV acks are durable (Serve state, etc.)
         return True

@@ -65,12 +65,17 @@ class _Bundle:
 class _PendingLease:
     def __init__(self, demand: Dict[str, float], is_actor: bool,
                  scheduling_key: str,
-                 bundle_key: Optional[str] = None):
+                 bundle_key: Optional[str] = None,
+                 request_id: Optional[str] = None,
+                 spillback_count: int = 0):
         self.demand = demand
         self.is_actor = is_actor
         self.scheduling_key = scheduling_key
         self.bundle_key = bundle_key
+        self.request_id = request_id
+        self.spillback_count = spillback_count
         self.conn: Optional[ServerConnection] = None
+        self.created_at = time.monotonic()
         self.future: asyncio.Future = asyncio.get_event_loop().create_future()
 
 
@@ -109,6 +114,9 @@ class Raylet:
         self._monitors: Dict[str, asyncio.Task] = {}
         # worker_id -> (monotonic push time, app-metric snapshot)
         self._worker_metrics: Dict[str, tuple] = {}
+        # lease request_id -> (lease_id, worker_id), for cancel-after-
+        # grant (a client that timed out must not leak the worker).
+        self._recent_grants: Dict[str, tuple] = {}
 
     @property
     def address(self) -> str:
@@ -148,11 +156,21 @@ class Raylet:
         await self._gcs.close()
 
     async def _register_with_gcs(self) -> None:
-        await self._gcs.register_node(
+        reply = await self._gcs.register_node(
             node_id=self.node_id, address=self.address,
             object_store_address=self.address,
             resources=self.resources_total, labels=self.labels,
             is_head=self.is_head)
+        if (reply or {}).get("was_dead"):
+            # The cluster declared us dead (transient partition) and has
+            # already restarted our actors / reconstructed our objects
+            # elsewhere. Surviving actor workers here are stale replicas
+            # holding chips and CPUs — reap them before resuming.
+            logger.warning("re-registered after being declared dead; "
+                           "reaping stale actor workers")
+            for worker in list(self._workers.values()):
+                if worker.actor_id and worker.proc.poll() is None:
+                    worker.proc.terminate()
 
     async def _heartbeat_loop(self) -> None:
         period = ray_config().raylet_heartbeat_period_ms / 1000.0
@@ -181,17 +199,35 @@ class Raylet:
             self._spill_infeasible_pending()
             await asyncio.sleep(period)
 
+    # A lease queued this long on a locally-feasible-but-busy node gets
+    # re-spilled to a remote with room (reference: the cluster task
+    # manager re-evaluates queued work against the cluster view; without
+    # this, an unlucky spillback distribution strands a lease behind a
+    # full node while a sibling node sits idle).
+    QUEUE_RESPILL_AFTER_S = 2.0
+
     def _spill_infeasible_pending(self) -> None:
         """Queued leases this node can never satisfy get redirected once
-        the refreshed cluster view shows a viable remote; until then they
+        the refreshed cluster view shows a viable remote; feasible ones
+        that have waited past QUEUE_RESPILL_AFTER_S re-spill too; others
         wait, with a periodic diagnostic (reference: the cluster task
         manager's 'cannot be scheduled' warning)."""
         now = time.monotonic()
         for pending in list(self._pending):
             if pending.bundle_key is not None:
                 continue
-            if self._feasible_locally(pending.demand):
+            if pending.spillback_count >= 2:
+                # The anti-ping-pong bound applies to queue re-spill too:
+                # a lease that already bounced twice settles where it is.
                 continue
+            if self._feasible_locally(pending.demand):
+                if now - pending.created_at < self.QUEUE_RESPILL_AFTER_S:
+                    continue
+                if self._fits(self.resources_available, pending.demand):
+                    # Resources are free — we're only waiting on a worker
+                    # to finish cold-spawning; re-spilling would strand
+                    # it and bounce the lease around the cluster.
+                    continue
             remote = self._pick_spillback(pending.demand)
             if remote is not None and not pending.future.done():
                 self._pending.remove(pending)
@@ -320,7 +356,8 @@ class Raylet:
             self, conn: ServerConnection, *, resources: Dict[str, float],
             scheduling_key: str = "", is_actor: bool = False,
             spillback_count: int = 0,
-            bundle: Optional[List[Any]] = None) -> Dict[str, Any]:
+            bundle: Optional[List[Any]] = None,
+            request_id: Optional[str] = None) -> Dict[str, Any]:
         demand = {k: float(v) for k, v in resources.items() if v}
         if logger.isEnabledFor(logging.DEBUG):
             logger.debug(
@@ -343,7 +380,8 @@ class Raylet:
                         "detail": f"demand {demand} exceeds bundle total "
                                   f"{b.total}"}
             pending = _PendingLease(demand, is_actor, scheduling_key,
-                                    bundle_key=key)
+                                    bundle_key=key, request_id=request_id,
+                                    spillback_count=spillback_count)
             pending.conn = conn
             self._pending.append(pending)
             self._try_dispatch()
@@ -367,7 +405,9 @@ class Raylet:
         # infeasible tasks wait in the cluster task manager until the
         # cluster changes — e.g. the node with that resource is still
         # registering); the heartbeat loop re-evaluates them for spillback.
-        pending = _PendingLease(demand, is_actor, scheduling_key)
+        pending = _PendingLease(demand, is_actor, scheduling_key,
+                                request_id=request_id,
+                                spillback_count=spillback_count)
         pending.conn = conn
         self._pending.append(pending)
         self._try_dispatch()
@@ -504,6 +544,12 @@ class Raylet:
                 worker.held = dict(pending.demand)
                 worker.bundle_key = pending.bundle_key
                 worker.chip_ids = chips
+                if pending.request_id is not None:
+                    self._recent_grants[pending.request_id] = (
+                        lease_id, worker.worker_id)
+                    while len(self._recent_grants) > 256:
+                        self._recent_grants.pop(
+                            next(iter(self._recent_grants)))
                 if not pending.future.done():
                     pending.future.set_result({
                         "granted": {
@@ -556,6 +602,24 @@ class Raylet:
         worker.held = {}
         worker.chip_ids = []
         worker.bundle_key = None
+
+    async def handle_cancel_lease_request(self, conn: ServerConnection, *,
+                                          request_id: str) -> bool:
+        """A client gave up on a lease (timeout): drop it from the queue,
+        or — if it was granted in the meantime — return the worker so the
+        abandoned grant doesn't leak its resources."""
+        for pending in self._pending:
+            if pending.request_id == request_id:
+                self._pending.remove(pending)
+                if not pending.future.done():
+                    pending.future.cancel()
+                return True
+        grant = self._recent_grants.pop(request_id, None)
+        if grant is not None:
+            lease_id, worker_id = grant
+            return await self.handle_return_worker(
+                conn, lease_id=lease_id, worker_id=worker_id)
+        return False
 
     async def handle_return_worker(self, conn: ServerConnection, *,
                                    lease_id: str, worker_id: str,

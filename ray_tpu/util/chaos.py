@@ -1,0 +1,111 @@
+"""Chaos harness: randomized fault injection for cluster tests.
+
+Reference equivalent: `python/ray/_private/test_utils.py:1391`
+(`NodeKillerActor`, `_kill_raylet :1477`) + the nightly chaos suite
+(`release/nightly_tests/setup_chaos.py`) — kill worker nodes on an
+interval while a workload runs, optionally replacing them, and assert
+the workload still completes correctly (task retries + lineage
+reconstruction + actor restarts are the machinery under test).
+
+Driver-side by design (the reference's is an actor so it can run inside
+a remote cluster; here tests own the `cluster_utils.Cluster` handle, so
+a thread that kills raylet child processes directly is simpler and
+cannot itself be killed by the chaos it causes).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class NodeKiller:
+    """Kills random non-head worker nodes of a `cluster_utils.Cluster`
+    on an interval; optionally starts a replacement node per kill so the
+    cluster keeps enough capacity for re-execution."""
+
+    def __init__(self, cluster, *, interval_s: float = 3.0,
+                 max_kills: int = 3, replace: bool = True,
+                 node_args: Optional[Dict] = None,
+                 seed: Optional[int] = None):
+        self._cluster = cluster
+        self.interval = interval_s
+        self.max_kills = max_kills
+        self.replace = replace
+        self.node_args = dict(node_args or {})
+        self.rng = random.Random(seed)
+        self.killed: List[str] = []
+        self._targets: List[dict] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def add_target(self, node: dict) -> None:
+        """Register a node (an `add_node` return) as killable."""
+        with self._lock:
+            self._targets.append(node)
+
+    def start(self) -> "NodeKiller":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="node-killer")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval + 10)
+
+    # ------------------------------------------------------------------
+    def _loop(self) -> None:
+        while (not self._stop.is_set()
+               and len(self.killed) < self.max_kills):
+            if self._stop.wait(self.interval):
+                break
+            with self._lock:
+                live = [n for n in self._targets
+                        if n["proc"].poll() is None]
+                if not live:
+                    continue
+                victim = self.rng.choice(live)
+            logger.info("chaos: killing node %s",
+                        victim["node_id"][:8])
+            try:
+                self._cluster.kill_node(victim)
+            except Exception:
+                logger.warning("chaos kill failed", exc_info=True)
+                continue
+            self.killed.append(victim["node_id"])
+            if self.replace and not self._stop.is_set():
+                try:
+                    replacement = self._cluster.add_node(**self.node_args)
+                    self.add_target(replacement)
+                    logger.info("chaos: replaced with %s",
+                                replacement["node_id"][:8])
+                except Exception:
+                    logger.warning("chaos replacement failed",
+                                   exc_info=True)
+
+
+def run_with_chaos(cluster, workload, *, targets: List[dict],
+                   interval_s: float = 3.0, max_kills: int = 2,
+                   replace: bool = True, node_args: Optional[Dict] = None,
+                   seed: Optional[int] = None):
+    """Run `workload()` while nodes die underneath it; returns
+    (workload result, list of killed node ids)."""
+    killer = NodeKiller(cluster, interval_s=interval_s,
+                        max_kills=max_kills, replace=replace,
+                        node_args=node_args, seed=seed)
+    for t in targets:
+        killer.add_target(t)
+    killer.start()
+    try:
+        result = workload()
+    finally:
+        killer.stop()
+    return result, killer.killed
