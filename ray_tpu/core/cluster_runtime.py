@@ -44,6 +44,10 @@ from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
 
 logger = logging.getLogger(__name__)
 
+# Per-thread deserialization context (suppress_borrow while unpacking
+# task args — the submitter pins those for the task's duration).
+_deser_ctx = threading.local()
+
 INLINE_LIMIT_KEY = "max_direct_call_object_size"
 
 
@@ -146,6 +150,10 @@ class ClusterRuntime:
         self._shm = WorkerStoreClient()
         self._owned: Dict[str, _Owned] = {}
         self._owned_lock = threading.Lock()
+        # Refs this process BORROWS (owner elsewhere): oid -> [owner
+        # address, local count, owner-ACKed]; zero -> release_borrow.
+        self._borrowed: Dict[str, list] = {}
+        self._borrowed_lock = threading.Lock()
         self._generators: Dict[str, ObjectRefGenerator] = {}
         self._put_counter = _Counter()
         self._lease_pools: Dict[str, _LeasePool] = {}
@@ -390,10 +398,15 @@ class ClusterRuntime:
             return entry
 
     def add_local_reference(self, object_id: ObjectID) -> None:
+        oid = object_id.hex()
         with self._owned_lock:
-            entry = self._owned.get(object_id.hex())
+            entry = self._owned.get(oid)
             if entry is not None:
                 entry.refcount += 1
+                return
+        with self._borrowed_lock:
+            if oid in self._borrowed:
+                self._borrowed[oid][1] += 1
 
     def remove_local_reference(self, object_id: ObjectID) -> None:
         if self._shutdown:
@@ -402,6 +415,7 @@ class ClusterRuntime:
         with self._owned_lock:
             entry = self._owned.get(oid)
             if entry is None:
+                self._release_borrow(oid)
                 return
             entry.refcount -= 1
             if entry.refcount > 0 or not entry.fut.done():
@@ -428,10 +442,94 @@ class ClusterRuntime:
             self._loop.spawn(_delete())
 
     def on_ref_deserialized(self, ref: ObjectRef) -> None:
+        oid = ref.hex()
         with self._owned_lock:
-            entry = self._owned.get(ref.hex())
+            entry = self._owned.get(oid)
             if entry is not None:
                 entry.refcount += 1
+                return
+        # A ref we do NOT own (e.g. embedded in a task's return value):
+        # register a borrow with its owner so the object outlives the
+        # owner process's own local references (reference:
+        # reference_count.h borrowing protocol). The owner's escrow pin
+        # (_escrow_pin) bridges the gap until this lands. Refs inside
+        # TASK ARGS skip this — the submitter pins them for the task's
+        # whole duration, and two extra owner RPCs per argument would
+        # tax the hot path.
+        if getattr(_deser_ctx, "suppress_borrow", False):
+            return
+        owner = ref._owner
+        if not isinstance(owner, str) or owner == self.address:
+            return
+        register = False
+        with self._borrowed_lock:
+            rec = self._borrowed.get(oid)
+            if rec is None:
+                # [owner, local count, owner ACKed the borrow]
+                rec = self._borrowed[oid] = [owner, 1, False]
+                register = True
+            else:
+                rec[1] += 1
+        if register:
+            async def _register(rec=rec):
+                try:
+                    client = await self._worker_client(owner)
+                    ok = await client.call("register_borrow", oid=oid,
+                                           timeout=30.0)
+                except Exception:
+                    return  # never ACKed: matching release stays local
+                with self._borrowed_lock:
+                    alive = self._borrowed.get(oid) is rec
+                    if alive:
+                        rec[2] = bool(ok)
+                if not alive and ok:
+                    # Released locally while the ACK was in flight: the
+                    # owner counted us, so compensate now.
+                    try:
+                        await client.call("release_borrow", oid=oid,
+                                          timeout=30.0)
+                    except Exception:
+                        pass
+
+            self._loop.spawn(_register())
+
+    def _release_borrow(self, oid: str) -> None:
+        with self._borrowed_lock:
+            rec = self._borrowed.get(oid)
+            if rec is None:
+                return
+            rec[1] -= 1
+            if rec[1] > 0:
+                return
+            del self._borrowed[oid]
+            owner = rec[0]
+            if not rec[2]:
+                # The owner never ACKed our register_borrow: sending a
+                # release would decrement a count that was never
+                # incremented (premature free at the owner).
+                return
+
+        async def _release():
+            try:
+                client = await self._worker_client(owner)
+                await client.call("release_borrow", oid=oid, timeout=30.0)
+            except Exception:
+                pass
+
+        self._loop.spawn(_release())
+
+    async def handle_register_borrow(self, conn, *, oid: str) -> bool:
+        """A remote process holds a ref to an object we own."""
+        with self._owned_lock:
+            entry = self._owned.get(oid)
+            if entry is None:
+                return False
+            entry.refcount += 1
+        return True
+
+    async def handle_release_borrow(self, conn, *, oid: str) -> bool:
+        self.remove_local_reference(ObjectID(bytes.fromhex(oid)))
+        return True
 
     # ==================================================================
     # objects: put / get / wait
@@ -928,8 +1026,9 @@ class ClusterRuntime:
                              bundle: Optional[Tuple[str, int]] = None,
                              address: Optional[str] = None) -> dict:
         address = address or self.raylet_address
-        pinned_address = address is not None and address != \
-            self.raylet_address  # PG bundle leases stay on their node
+        # PG-bundle leases are pinned to their reserved node; everything
+        # else reached via a non-local address is a spillback target.
+        pinned_address = address != self.raylet_address
         spillbacks = 0
         request_id = uuid.uuid4().hex
         while True:
@@ -938,10 +1037,14 @@ class ClusterRuntime:
                 # (stale cluster view) must cost ~2s, not a full connect
                 # window per retry — fall back to the local raylet, whose
                 # view refreshes within the health-check period.
+                # Short dial ONLY for spillback targets (possibly dead,
+                # stale view); local and PG-pinned addresses keep the
+                # full window.
+                is_spillback_target = (not pinned_address
+                                       and address != self.raylet_address)
                 client = await self._raylet_client(
                     address,
-                    connect_timeout=(10.0 if address == self.raylet_address
-                                     else 2.0))
+                    connect_timeout=2.0 if is_spillback_target else 10.0)
             except (ConnectionLost, OSError):
                 if pinned_address or address == self.raylet_address:
                     raise
@@ -1114,10 +1217,16 @@ class ClusterRuntime:
                     creation["demand"], is_actor=True, bundle=bundle,
                     address=address)
                 break
-            except (TimeoutError, asyncio.TimeoutError, OSError):
+            except (TimeoutError, asyncio.TimeoutError, OSError,
+                    ConnectionLost):
+                # RpcError refusals (infeasible demand, missing bundle)
+                # are deterministic — retrying them only delays the real
+                # error.
                 attempt += 1
                 if attempt > 3:
                     raise
+                await asyncio.sleep(
+                    ray_config().task_retry_delay_ms / 1000.0 or 0.2)
         client = await self._worker_client(worker["worker_address"])
         try:
             reply = await client.call(
@@ -1779,16 +1888,39 @@ class ClusterRuntime:
             self._job_envs_applied.add(job_id)
 
     def _resolve_task_args(self, args_blob: bytes):
-        args, kwargs = self._deserialize_payload(args_blob)
+        _deser_ctx.suppress_borrow = True
+        try:
+            args, kwargs = self._deserialize_payload(args_blob)
+        finally:
+            _deser_ctx.suppress_borrow = False
         args = [self.get(a) if isinstance(a, ObjectRef) else a for a in args]
         kwargs = {k: self.get(v) if isinstance(v, ObjectRef) else v
                   for k, v in kwargs.items()}
         return args, kwargs
 
+    # How long a result-embedded ref stays escrow-pinned in its owner
+    # process, bridging the gap between shipping the result and the
+    # consumer's register_borrow (reference: the borrowing protocol of
+    # reference_count.h; here time-bounded rather than tracked per
+    # containing object).
+    BORROW_ESCROW_S = 600.0
+
+    def _escrow_pin(self, ref) -> None:
+        """Pin a ref embedded in an outgoing result until consumers had
+        ample time to register their borrow."""
+        self.add_local_reference(ref.id())
+
+        async def _release_later(object_id=ref.id()):
+            await asyncio.sleep(self.BORROW_ESCROW_S)
+            self.remove_local_reference(object_id)
+
+        self._loop.spawn(_release_later())
+
     def _package_result(self, oid: str, value: Any,
                         is_error: bool = False) -> dict:
         so = (serialization.serialize_error(value) if is_error
-              else serialization.serialize(value))
+              else serialization.serialize(
+                  value, ref_serializer=self._escrow_pin))
         size = so.total_size()
         if size <= ray_config().max_direct_call_object_size:
             return {"oid": oid, "inline": so.to_bytes()}

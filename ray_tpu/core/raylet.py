@@ -117,6 +117,10 @@ class Raylet:
         # lease request_id -> (lease_id, worker_id), for cancel-after-
         # grant (a client that timed out must not leak the worker).
         self._recent_grants: Dict[str, tuple] = {}
+        # live lease_id -> (worker_id, granting connection): a client
+        # that dies (not merely times out) can never use or return its
+        # grants, so disconnect reclaims them.
+        self._lease_conns: Dict[str, tuple] = {}
 
     @property
     def address(self) -> str:
@@ -216,11 +220,13 @@ class Raylet:
         for pending in list(self._pending):
             if pending.bundle_key is not None:
                 continue
-            if pending.spillback_count >= 2:
-                # The anti-ping-pong bound applies to queue re-spill too:
-                # a lease that already bounced twice settles where it is.
-                continue
             if self._feasible_locally(pending.demand):
+                if pending.spillback_count >= 2:
+                    # Anti-ping-pong: a busy-node lease that already
+                    # bounced twice settles where it is. (Locally
+                    # INFEASIBLE leases are exempt — this node can never
+                    # run them, so redirecting is their only way out.)
+                    continue
                 if now - pending.created_at < self.QUEUE_RESPILL_AFTER_S:
                     continue
                 if self._fits(self.resources_available, pending.demand):
@@ -544,6 +550,8 @@ class Raylet:
                 worker.held = dict(pending.demand)
                 worker.bundle_key = pending.bundle_key
                 worker.chip_ids = chips
+                self._lease_conns[lease_id] = (worker.worker_id,
+                                               pending.conn)
                 if pending.request_id is not None:
                     self._recent_grants[pending.request_id] = (
                         lease_id, worker.worker_id)
@@ -625,6 +633,7 @@ class Raylet:
                                    lease_id: str, worker_id: str,
                                    resources: Optional[Dict[str, float]]
                                    = None, dead: bool = False) -> bool:
+        self._lease_conns.pop(lease_id, None)
         worker = self._workers.get(worker_id)
         if worker is not None and worker.lease_id == lease_id:
             # A worker that held TPU chips cannot be reused: libtpu pins
@@ -657,6 +666,11 @@ class Raylet:
         the actor's running demand (placement CPU released after __init__)."""
         worker = self._workers.get(worker_id)
         if worker is not None:
+            # An actor worker's lifetime is governed by actor semantics
+            # (GCS liveness, max_restarts, detached), NOT by its creation
+            # lease's connection — exempt it from dead-client reclaim.
+            if worker.lease_id is not None:
+                self._lease_conns.pop(worker.lease_id, None)
             worker.actor_id = actor_id
             worker.actor_job_id = job_id
             worker.actor_detached = detached
@@ -862,11 +876,27 @@ class Raylet:
 
     async def on_client_disconnect(self, conn: ServerConnection) -> None:
         """Drop queued lease requests from a vanished client so a later
-        grant doesn't strand a worker + its resources."""
+        grant doesn't strand a worker + its resources, and reclaim
+        leases it was already granted (a dead client can never use or
+        return them)."""
         for pending in [p for p in self._pending if p.conn is conn]:
             self._pending.remove(pending)
             if not pending.future.done():
                 pending.future.cancel()
+        for lease_id, (worker_id, owner_conn) in list(
+                self._lease_conns.items()):
+            if owner_conn is not conn:
+                continue
+            worker = self._workers.get(worker_id)
+            if worker is not None and (worker.actor_id
+                                       or worker.state == "actor"):
+                # Actor lifetimes are actor-managed, never conn-managed.
+                self._lease_conns.pop(lease_id, None)
+                continue
+            # dead=True: the worker may be mid-task for the dead
+            # client; terminating is the only safe reset.
+            await self.handle_return_worker(
+                conn, lease_id=lease_id, worker_id=worker_id, dead=True)
 
     async def handle_pull_object(self, conn: ServerConnection, *, oid: str,
                                  owner_address: Optional[str],

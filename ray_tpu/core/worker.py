@@ -66,7 +66,13 @@ def init(address: Optional[str] = None, *,
         from ray_tpu.core.config import ray_config
         ray_config().apply_system_config(_system_config)
 
-        if local_mode:
+        if address and address.startswith("ray://"):
+            # Remote driver through the client proxy (reference:
+            # python/ray/util/client — ray.init("ray://host:port")).
+            from ray_tpu.util.client.runtime import ClientRuntime
+            _runtime = ClientRuntime(address[len("ray://"):],
+                                     namespace=namespace)
+        elif local_mode:
             from ray_tpu.core.local_mode import LocalModeRuntime
             _runtime = LocalModeRuntime(num_cpus=num_cpus, namespace=namespace)
         else:

@@ -268,13 +268,25 @@ class Store {
   // wrappers after ops that may queue spills (i.e. on the executor
   // thread, never the raylet event loop).
   void flush_spills() {
+    {
+      // Single active flusher: two threads both treating the deque
+      // front as "their" item would write the same file and double-free
+      // its buffer. Items enqueued while a flusher runs are covered by
+      // its loop (or by the next store op's flush call).
+      std::lock_guard<std::mutex> g(mu_);
+      if (flushing_) return;
+      flushing_ = true;
+    }
     for (;;) {
       std::string oid, path;
       uint8_t* buf;
       uint64_t size;
       {
         std::lock_guard<std::mutex> g(mu_);
-        if (pending_spills_.empty()) return;
+        if (pending_spills_.empty()) {
+          flushing_ = false;
+          return;
+        }
         PendingSpill& front = pending_spills_.front();
         auto it = objects_.find(front.oid);
         // Deleted, or restored from the buffer already: nothing to write.
@@ -291,7 +303,12 @@ class Store {
         size = front.size;
         path = spill_path(oid);
       }
-      bool ok = !spill_broken_;
+      bool ok;
+      {
+        // spill_broken_ is written under mu_; read it there too.
+        std::lock_guard<std::mutex> g(mu_);
+        ok = !spill_broken_;
+      }
       if (ok) {
         FILE* f = fopen(path.c_str(), "wb");
         ok = f != nullptr;
@@ -318,6 +335,7 @@ class Store {
         // readable from memory) and stop spilling new victims.
         spill_broken_ = true;
         pending_spills_.push_front({oid, buf, size, false});
+        flushing_ = false;
         cv_.notify_all();
         return;
       }
@@ -568,6 +586,7 @@ class Store {
   uint64_t capacity_;
   uint64_t used_ = 0;
   bool spill_broken_ = false;
+  bool flushing_ = false;
   std::unordered_map<std::string, Entry> objects_;
   std::list<std::string> lru_;  // resident sealed objects, oldest first
   std::deque<PendingSpill> pending_spills_;

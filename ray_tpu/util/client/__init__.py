@@ -1,0 +1,18 @@
+"""Ray Client equivalent: remote drivers over one proxy endpoint.
+
+Reference: `python/ray/util/client/` (+ `server/`, architecture doc
+`python/ray/util/client/ARCHITECTURE.md`) — `ray.init("ray://host:port")`
+runs the driver OUTSIDE the cluster network; every API call forwards over
+a single connection to a proxy that executes it with a real in-cluster
+runtime.
+
+Design here: the client is "a worker that can only reach the proxy". The
+existing ref-aware serialization (`core/serialization.py`) already moves
+values+refs between processes, so the wire format is the same framed RPC
+the rest of the runtime uses; the proxy holds a real ObjectRef for every
+ref it hands a client (its refcount keeps the object alive) and releases
+them on client_release or client disconnect.
+"""
+
+from ray_tpu.util.client.runtime import ClientRuntime  # noqa: F401
+from ray_tpu.util.client.server import ClientProxy  # noqa: F401

@@ -1,0 +1,210 @@
+"""Client-side runtime: the full ray_tpu API over one proxy connection.
+
+Reference: `python/ray/util/client/worker.py` — a Runtime implementation
+whose every operation forwards to the in-cluster proxy. Activated by
+`ray_tpu.init(address="ray://host:port")`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ActorID, ObjectID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.rpc import EventLoopThread, RpcClient
+
+logger = logging.getLogger(__name__)
+
+
+class ClientRuntime:
+    """Remote-driver runtime (mode="client")."""
+
+    def __init__(self, address: str, *, namespace: Optional[str] = None):
+        self.mode = "client"
+        self.proxy_address = address
+        self._loop = EventLoopThread(name="client-rpc")
+        self._rpc = RpcClient(address)
+        self._loop.run(self._rpc.connect(timeout=30.0))
+        hello = self._call("client_hello", namespace=namespace)
+        self.namespace = hello["namespace"]
+        self._registered: set = set()
+        self._reg_lock = threading.Lock()
+        # Local refcounts; zero -> async release to the proxy.
+        self._refcounts: Dict[str, int] = {}
+        self._refcount_lock = threading.Lock()
+        self._shutdown = False
+
+    # -- plumbing -------------------------------------------------------
+    def _call(self, method: str, *, timeout: Optional[float] = 300.0,
+              **kwargs: Any) -> Any:
+        return self._loop.run(
+            self._rpc.call(method, timeout=timeout, **kwargs))
+
+    def _pack(self, value) -> bytes:
+        return serialization.serialize(value).to_bytes()
+
+    def _ref_from_hex(self, ref_hex: str) -> ObjectRef:
+        return ObjectRef(ObjectID(bytes.fromhex(ref_hex)),
+                         owner=self.proxy_address, runtime=self)
+
+    def _ensure_registered(self, kind: str, obj) -> str:
+        blob = self._pack(obj)
+        key = hashlib.sha1(blob).hexdigest()
+        with self._reg_lock:
+            if key not in self._registered:
+                self._call("client_register", kind=kind, key=key,
+                           blob=blob)
+                self._registered.add(key)
+        return key
+
+    # -- objects --------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("Calling put() on an ObjectRef is not allowed.")
+        ref_hex = self._call("client_put", blob=self._pack(value))
+        return self._ref_from_hex(ref_hex)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list: List[ObjectRef] = [refs] if single else list(refs)
+        if not ref_list:
+            return [] if not single else None
+        # `get_timeout` is the object deadline; the transport deadline
+        # wraps it with slack (None = block until objects materialize).
+        reply = self._call(
+            "client_get", ref_ids=[r.hex() for r in ref_list],
+            get_timeout=timeout,
+            timeout=None if timeout is None else timeout + 60.0)
+        if "error" in reply:
+            raise serialization.deserialize(reply["error"])
+        values = [serialization.deserialize(b) for b in reply["values"]]
+        return values[0] if single else values
+
+    def wait(self, refs, num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True
+             ) -> Tuple[list, list]:
+        ref_list = list(refs)
+        by_hex = {r.hex(): r for r in ref_list}
+        reply = self._call(
+            "client_wait", ref_ids=[r.hex() for r in ref_list],
+            num_returns=num_returns, wait_timeout=timeout,
+            fetch_local=fetch_local,
+            timeout=None if timeout is None else timeout + 60.0)
+        return ([by_hex[h] for h in reply["ready"]],
+                [by_hex[h] for h in reply["pending"]])
+
+    # -- refcounting ----------------------------------------------------
+    def add_local_reference(self, object_id: ObjectID) -> None:
+        with self._refcount_lock:
+            oid = object_id.hex()
+            self._refcounts[oid] = self._refcounts.get(oid, 0) + 1
+
+    def remove_local_reference(self, object_id: ObjectID) -> None:
+        if self._shutdown:
+            return
+        oid = object_id.hex()
+        with self._refcount_lock:
+            n = self._refcounts.get(oid, 0) - 1
+            if n > 0:
+                self._refcounts[oid] = n
+                return
+            self._refcounts.pop(oid, None)
+        try:
+            self._loop.spawn(self._rpc.call(
+                "client_release", ref_ids=[oid], timeout=30.0))
+        except Exception:
+            pass  # interpreter teardown
+
+    def on_ref_deserialized(self, ref: ObjectRef) -> None:
+        self.add_local_reference(ref.id())
+
+    # -- tasks ----------------------------------------------------------
+    def submit_task(self, remote_function, opts, args, kwargs):
+        fn_key = self._ensure_registered("function", remote_function)
+        ref_ids = self._call(
+            "client_task", fn_key=fn_key,
+            args_blob=self._pack((tuple(args), dict(kwargs))),
+            opts_blob=self._pack(opts))
+        refs = [self._ref_from_hex(r) for r in ref_ids]
+        if getattr(opts, "num_returns", 1) == 0:
+            return None
+        return refs[0] if len(refs) == 1 else refs
+
+    # -- actors ---------------------------------------------------------
+    def create_actor(self, actor_class, opts, args, kwargs):
+        from ray_tpu.core.actor import ActorHandle
+
+        cls_key = self._ensure_registered("class", actor_class)
+        reply = self._call(
+            "client_create_actor", cls_key=cls_key,
+            args_blob=self._pack((tuple(args), dict(kwargs))),
+            opts_blob=self._pack(opts))
+        return ActorHandle(
+            ActorID(bytes.fromhex(reply["actor_id"])),
+            reply["class_name"],
+            serialization.deserialize(reply["meta"]), runtime=self)
+
+    def submit_actor_task(self, handle, method_name, opts, args, kwargs):
+        ref_ids = self._call(
+            "client_actor_task", actor_id=handle._actor_id.hex(),
+            method_name=method_name,
+            args_blob=self._pack((tuple(args), dict(kwargs))),
+            opts_blob=self._pack(opts))
+        refs = [self._ref_from_hex(r) for r in ref_ids]
+        if not refs:
+            return None
+        return refs[0] if len(refs) == 1 else refs
+
+    def kill_actor(self, handle, no_restart: bool = True) -> None:
+        self._call("client_kill_actor", actor_id=handle._actor_id.hex(),
+                   no_restart=no_restart)
+
+    def get_actor(self, name: str, namespace: Optional[str] = None):
+        from ray_tpu.core.actor import ActorHandle
+
+        reply = self._call("client_get_actor", name=name,
+                           namespace=namespace)
+        return ActorHandle(
+            ActorID(bytes.fromhex(reply["actor_id"])),
+            reply["class_name"],
+            serialization.deserialize(reply["meta"]), runtime=self)
+
+    def cancel(self, ref: ObjectRef, force: bool = False,
+               recursive: bool = True) -> None:
+        self._call("client_cancel", ref_id=ref.hex(), force=force,
+                   recursive=recursive)
+
+    # -- introspection --------------------------------------------------
+    def nodes(self) -> list:
+        return serialization.deserialize(
+            self._call("client_cluster_info", what="nodes"))
+
+    def cluster_resources(self) -> dict:
+        return serialization.deserialize(
+            self._call("client_cluster_info", what="cluster_resources"))
+
+    def available_resources(self) -> dict:
+        return serialization.deserialize(
+            self._call("client_cluster_info",
+                       what="available_resources"))
+
+    def timeline(self) -> list:
+        return []  # task events stay cluster-side (use the dashboard)
+
+    def task_events(self, job_id: Optional[str] = None) -> list:
+        return []
+
+    # -- lifecycle ------------------------------------------------------
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        try:
+            self._loop.run(self._rpc.close(), timeout=5)
+        except Exception:
+            pass
+        self._loop.stop()

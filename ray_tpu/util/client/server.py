@@ -1,0 +1,276 @@
+"""Client proxy server (reference: python/ray/util/client/server/).
+
+Runs inside the cluster (usually on the head node), executes forwarded
+API calls against its own driver runtime, and tracks per-connection
+ownership so a vanished client leaks neither objects nor actors.
+
+Start standalone:  python -m ray_tpu.util.client.server \
+                       [--address GCS] [--port 10001]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import logging
+from typing import Any, Dict, Optional
+
+from ray_tpu.core import serialization
+from ray_tpu.core.rpc import RpcServer, ServerConnection
+
+logger = logging.getLogger(__name__)
+
+
+class ClientProxy:
+    def __init__(self, runtime, host: str = "127.0.0.1",
+                 port: int = 10001):
+        self._rt = runtime
+        self._rpc = RpcServer(self, host, port)
+        # Proxy-held refs: ref hex -> (ObjectRef, owner connection).
+        # Holding the real ObjectRef IS the distributed refcount.
+        self._refs: Dict[str, tuple] = {}
+        # Registered function/class blobs, keyed by client-supplied id.
+        self._functions: Dict[str, Any] = {}
+        self._classes: Dict[str, Any] = {}
+        self._actors: Dict[str, tuple] = {}  # actor_id -> (handle, conn)
+        # Dedicated pool for BLOCKING get/wait forwards: the default
+        # executor's ~12 threads would let a dozen long gets starve
+        # every other client's already-ready gets.
+        self._blocking_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="client-proxy-wait")
+
+    @property
+    def address(self) -> str:
+        return self._rpc.address
+
+    async def start(self) -> None:
+        await self._rpc.start()
+        logger.info("client proxy listening on %s", self.address)
+
+    async def stop(self) -> None:
+        await self._rpc.stop()
+
+    # -- plumbing -------------------------------------------------------
+    def _track(self, ref, conn: ServerConnection) -> str:
+        self._refs[ref.hex()] = (ref, conn)
+        conn.metadata.setdefault("client_refs", set()).add(ref.hex())
+        return ref.hex()
+
+    def _ref(self, ref_id: str):
+        entry = self._refs.get(ref_id)
+        if entry is None:
+            raise KeyError(f"unknown/released client ref {ref_id[:16]}")
+        return entry[0]
+
+    def _deserialize_args(self, blob: bytes):
+        # Embedded refs rebuild against the proxy's runtime via the
+        # standard __reduce__ path (object_ref._rebuild_object_ref).
+        return serialization.deserialize(blob)
+
+    def _pack_value(self, value, conn: ServerConnection) -> bytes:
+        # Refs NESTED in returned values must be tracked (pinned) too, or
+        # the client gets a ref the proxy doesn't know and the object's
+        # refcount can hit zero while the client still holds it.
+        return serialization.serialize(
+            value, ref_serializer=lambda r: self._track(r, conn)
+        ).to_bytes()
+
+    async def on_client_disconnect(self, conn: ServerConnection) -> None:
+        """Release everything the vanished client owned."""
+        for ref_id in conn.metadata.get("client_refs", ()):  # noqa: B020
+            self._refs.pop(ref_id, None)
+        for actor_id in list(conn.metadata.get("client_actors", ())):
+            entry = self._actors.pop(actor_id, None)
+            if entry is not None:
+                handle, _ = entry
+                try:
+                    self._rt.kill_actor(handle, no_restart=True)
+                except Exception:
+                    pass
+
+    # -- session --------------------------------------------------------
+    async def handle_client_hello(self, conn: ServerConnection, *,
+                                  namespace: Optional[str] = None) -> dict:
+        return {"namespace": namespace or self._rt.namespace,
+                "proxy": self.address}
+
+    # -- objects --------------------------------------------------------
+    async def handle_client_put(self, conn: ServerConnection, *,
+                                blob: bytes) -> str:
+        value = self._deserialize_args(blob)
+        ref = self._rt.put(value)
+        return self._track(ref, conn)
+
+    async def handle_client_get(self, conn: ServerConnection, *,
+                                ref_ids: list,
+                                get_timeout: Optional[float]) -> dict:
+        refs = [self._ref(r) for r in ref_ids]
+        loop = asyncio.get_running_loop()
+        try:
+            # The runtime's get() blocks; keep the proxy loop free.
+            values = await loop.run_in_executor(
+                self._blocking_pool,
+                lambda: self._rt.get(refs, timeout=get_timeout))
+        except BaseException as e:  # noqa: BLE001
+            return {"error": serialization.serialize_error(e).to_bytes()}
+        # refs was a list, so rt.get returned a list — no wrapping.
+        return {"values": [self._pack_value(v, conn) for v in values]}
+
+    async def handle_client_wait(self, conn: ServerConnection, *,
+                                 ref_ids: list, num_returns: int,
+                                 wait_timeout: Optional[float],
+                                 fetch_local: bool = True) -> dict:
+        refs = [self._ref(r) for r in ref_ids]
+        loop = asyncio.get_running_loop()
+        ready, pending = await loop.run_in_executor(
+            self._blocking_pool, lambda: self._rt.wait(
+                refs, num_returns=num_returns, timeout=wait_timeout,
+                fetch_local=fetch_local))
+        return {"ready": [r.hex() for r in ready],
+                "pending": [r.hex() for r in pending]}
+
+    async def handle_client_release(self, conn: ServerConnection, *,
+                                    ref_ids: list) -> int:
+        n = 0
+        for r in ref_ids:
+            if self._refs.pop(r, None) is not None:
+                conn.metadata.get("client_refs", set()).discard(r)
+                n += 1
+        return n
+
+    # -- tasks ----------------------------------------------------------
+    async def handle_client_register(self, conn: ServerConnection, *,
+                                     kind: str, key: str,
+                                     blob: bytes) -> bool:
+        obj = serialization.deserialize(blob)
+        (self._functions if kind == "function" else self._classes)[key] = obj
+        return True
+
+    async def handle_client_task(self, conn: ServerConnection, *,
+                                 fn_key: str, args_blob: bytes,
+                                 opts_blob: bytes) -> list:
+        remote_fn = self._functions.get(fn_key)
+        if remote_fn is None:
+            raise KeyError(f"function {fn_key} not registered")
+        args, kwargs = self._deserialize_args(args_blob)
+        opts = serialization.deserialize(opts_blob)
+        out = self._rt.submit_task(remote_fn, opts, args, kwargs)
+        refs = out if isinstance(out, (list, tuple)) else \
+            ([] if out is None else [out])
+        return [self._track(r, conn) for r in refs]
+
+    # -- actors ---------------------------------------------------------
+    async def handle_client_create_actor(self, conn: ServerConnection, *,
+                                         cls_key: str, args_blob: bytes,
+                                         opts_blob: bytes) -> dict:
+        actor_class = self._classes.get(cls_key)
+        if actor_class is None:
+            raise KeyError(f"class {cls_key} not registered")
+        args, kwargs = self._deserialize_args(args_blob)
+        opts = serialization.deserialize(opts_blob)
+        loop = asyncio.get_running_loop()
+        handle = await loop.run_in_executor(
+            None, lambda: self._rt.create_actor(actor_class, opts, args,
+                                                kwargs))
+        actor_id = handle._actor_id.hex() if hasattr(
+            handle._actor_id, "hex") else str(handle._actor_id)
+        self._actors[actor_id] = (handle, conn)
+        conn.metadata.setdefault("client_actors", set()).add(actor_id)
+        return {"actor_id": actor_id,
+                "class_name": handle._class_name,
+                "meta": serialization.serialize(
+                    handle._method_meta).to_bytes()}
+
+    def _actor_handle(self, actor_id: str):
+        entry = self._actors.get(actor_id)
+        if entry is None:
+            raise KeyError(f"unknown client actor {actor_id[:16]}")
+        return entry[0]
+
+    async def handle_client_actor_task(self, conn: ServerConnection, *,
+                                       actor_id: str, method_name: str,
+                                       args_blob: bytes,
+                                       opts_blob: bytes) -> list:
+        handle = self._actor_handle(actor_id)
+        args, kwargs = self._deserialize_args(args_blob)
+        opts = serialization.deserialize(opts_blob)
+        out = self._rt.submit_actor_task(handle, method_name, opts, args,
+                                         kwargs)
+        refs = out if isinstance(out, (list, tuple)) else \
+            ([] if out is None else [out])
+        return [self._track(r, conn) for r in refs]
+
+    async def handle_client_kill_actor(self, conn: ServerConnection, *,
+                                       actor_id: str,
+                                       no_restart: bool = True) -> bool:
+        handle = self._actor_handle(actor_id)
+        self._rt.kill_actor(handle, no_restart=no_restart)
+        if no_restart:
+            self._actors.pop(actor_id, None)
+            conn.metadata.get("client_actors", set()).discard(actor_id)
+        return True
+
+    async def handle_client_get_actor(self, conn: ServerConnection, *,
+                                      name: str,
+                                      namespace: Optional[str]) -> dict:
+        handle = self._rt.get_actor(name, namespace=namespace)
+        actor_id = handle._actor_id.hex() if hasattr(
+            handle._actor_id, "hex") else str(handle._actor_id)
+        # Register for method calls, but do NOT mark it for
+        # kill-on-disconnect: this connection merely looked up a shared
+        # named actor, it doesn't own its lifetime.
+        self._actors.setdefault(actor_id, (handle, conn))
+        return {"actor_id": actor_id,
+                "class_name": handle._class_name,
+                "meta": serialization.serialize(
+                    handle._method_meta).to_bytes()}
+
+    async def handle_client_cancel(self, conn: ServerConnection, *,
+                                   ref_id: str, force: bool,
+                                   recursive: bool) -> bool:
+        self._rt.cancel(self._ref(ref_id), force=force,
+                        recursive=recursive)
+        return True
+
+    # -- cluster introspection -----------------------------------------
+    async def handle_client_cluster_info(self, conn: ServerConnection, *,
+                                         what: str) -> bytes:
+        if what == "nodes":
+            data = self._rt.nodes()
+        elif what == "cluster_resources":
+            data = self._rt.cluster_resources()
+        elif what == "available_resources":
+            data = self._rt.available_resources()
+        else:
+            raise ValueError(f"unknown cluster info {what!r}")
+        return self._pack_value(data, conn)
+
+
+async def _amain(address: Optional[str], host: str, port: int) -> None:
+    import ray_tpu
+
+    ray_tpu.init(address=address)
+    from ray_tpu.core.worker import current_runtime
+
+    proxy = ClientProxy(current_runtime(), host=host, port=port)
+    await proxy.start()
+    print(f"CLIENT_PROXY_READY {proxy.address}", flush=True)
+    await asyncio.Event().wait()
+
+
+def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--address", default=None,
+                        help="existing cluster GCS address (default: "
+                             "start a local cluster)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=10001)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(_amain(args.address, args.host, args.port))
+
+
+if __name__ == "__main__":
+    main()

@@ -425,3 +425,23 @@ def test_object_released_on_ref_drop(ray_start_local):
     import gc
     gc.collect()
     assert len(runtime._objects) <= before + 2
+
+
+def test_refs_in_return_values_borrowing(ray_start_regular):
+    """A ref created inside a task (owned by the worker) survives the
+    worker's local release via the borrowing protocol (reference:
+    reference_count.h — escrow pin + register_borrow)."""
+    import numpy as np
+    import ray_tpu
+
+    @ray_tpu.remote
+    def make_nested():
+        inner = ray_tpu.put(np.arange(1000))
+        return {"ref": inner, "tag": "x"}
+
+    out = ray_tpu.get(make_nested.remote(), timeout=120)
+    assert out["tag"] == "x"
+    vals = ray_tpu.get(out["ref"], timeout=120)
+    assert int(vals.sum()) == 499500
+    # Still fetchable on a second get (borrow persists until release).
+    assert int(ray_tpu.get(out["ref"], timeout=120).sum()) == 499500
