@@ -1908,7 +1908,19 @@ class ClusterRuntime:
     def _escrow_pin(self, ref) -> None:
         """Pin a ref embedded in an outgoing result until consumers had
         ample time to register their borrow."""
-        self.add_local_reference(ref.id())
+        oid = ref.hex()
+        with self._owned_lock:
+            known = oid in self._owned
+        if not known:
+            with self._borrowed_lock:
+                known = oid in self._borrowed
+        if known:
+            self.add_local_reference(ref.id())
+        else:
+            # A pass-through ref (arrived as a task arg under
+            # suppress_borrow, now re-exported in our result): register
+            # a real borrow with its owner so the pin actually holds.
+            self.on_ref_deserialized(ref)
 
         async def _release_later(object_id=ref.id()):
             await asyncio.sleep(self.BORROW_ESCROW_S)

@@ -72,12 +72,49 @@ class Deployment:
 
 class Application:
     """A bound deployment graph node (reference: serve/api.py
-    Application). MVP: a single deployment + its init args."""
+    Application + deployment_graph_build.py): a deployment plus init
+    args which may themselves contain bound Applications — `serve.run`
+    deploys the children first and replaces them with handles (model
+    composition)."""
 
     def __init__(self, deployment: Deployment, args, kwargs):
         self.deployment = deployment
         self.init_args = args
         self.init_kwargs = kwargs
+
+
+class _HandleMarker:
+    """Placeholder for a child deployment's handle inside init args;
+    swapped for a live DeploymentHandle in the replica process."""
+
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+
+    def __repr__(self):
+        return f"_HandleMarker({self.deployment_name})"
+
+
+def _map_tree(value, leaf_fn):
+    """Shared structural walk for handle substitution/resolution —
+    one walker so deploy-side and replica-side can't drift."""
+    mapped = leaf_fn(value)
+    if mapped is not value:
+        return mapped
+    if isinstance(value, tuple):
+        return tuple(_map_tree(v, leaf_fn) for v in value)
+    if isinstance(value, list):
+        return [_map_tree(v, leaf_fn) for v in value]
+    if isinstance(value, dict):
+        return {k: _map_tree(v, leaf_fn) for k, v in value.items()}
+    return value
+
+
+def _substitute_applications(value, deploy_child):
+    """Deep-replace bound Applications with handle markers, deploying
+    each child (post-order) via `deploy_child(app) -> name`."""
+    return _map_tree(
+        value, lambda v: _HandleMarker(deploy_child(v))
+        if isinstance(v, Application) else v)
 
 
 def deployment(cls=None, *, name: Optional[str] = None,
@@ -153,14 +190,54 @@ def run(app: Application, *, name: str = "default",
 
     start()
     controller = ray_tpu.get_actor(CONTROLLER_NAME)
+
+    deployed: list = []
+    assigned: dict = {}     # id(Application) -> deployed name (diamonds)
+    used_names: set = set()
+
+    def deploy_child(child: Application) -> str:
+        if id(child) in assigned:
+            return assigned[id(child)]  # same bound node reused: share
+        base = child.deployment.name
+        name = base
+        n = 1
+        while name in used_names:
+            # Two DIFFERENT children of the same class must not collapse
+            # into one deployment (reference uniquifies graph nodes).
+            name = f"{base}_{n}"
+            n += 1
+        used_names.add(name)
+        assigned[id(child)] = name
+        _deploy_app(controller, child, route_prefix=None,
+                    deploy_child=deploy_child, name=name)
+        deployed.append(name)
+        return name
+
+    _deploy_app(controller, app, route_prefix=route_prefix,
+                deploy_child=deploy_child)
     dep = app.deployment
-    ray_tpu.get(controller.deploy.remote(
-        dep.name, dep._cls, app.init_args, app.init_kwargs, dep.config,
-        route_prefix=route_prefix), timeout=60)
     handle = DeploymentHandle(dep.name, controller)
     if wait_for_ready:
-        _wait_ready(controller, dep.name, _blocking_timeout_s)
+        for name in deployed + [dep.name]:
+            _wait_ready(controller, name, _blocking_timeout_s)
     return handle
+
+
+def _deploy_app(controller, app: Application,
+                route_prefix: Optional[str], deploy_child,
+                name: Optional[str] = None) -> None:
+    import ray_tpu
+
+    dep = app.deployment
+    # Children deploy first (post-order), so by the time this deployment
+    # constructs, its dependencies resolve.
+    init_args = _substitute_applications(tuple(app.init_args),
+                                         deploy_child)
+    init_kwargs = _substitute_applications(dict(app.init_kwargs),
+                                           deploy_child)
+    ray_tpu.get(controller.deploy.remote(
+        name or dep.name, dep._cls, init_args, init_kwargs, dep.config,
+        route_prefix=route_prefix), timeout=60)
 
 
 def _wait_ready(controller, deployment_name: str,

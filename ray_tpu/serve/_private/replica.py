@@ -14,10 +14,32 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 
+def _resolve_handle_markers(value):
+    """Swap composition markers (serve._HandleMarker) for live
+    DeploymentHandles (reference: the replica-side handle injection of
+    the deployment graph). Uses the same _map_tree walker as the
+    deploy-side substitution."""
+    from ray_tpu.serve import _HandleMarker, _map_tree
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    def leaf(v):
+        if isinstance(v, _HandleMarker):
+            import ray_tpu
+            from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            return DeploymentHandle(v.deployment_name, controller)
+        return v
+
+    return _map_tree(value, leaf)
+
+
 class Replica:
     def __init__(self, cls_factory, init_args: Tuple, init_kwargs: Dict,
                  deployment_name: str, replica_id: str,
                  version: Optional[str]):
+        init_args = _resolve_handle_markers(tuple(init_args))
+        init_kwargs = _resolve_handle_markers(dict(init_kwargs))
         self._instance = cls_factory(*init_args, **init_kwargs)
         self.deployment_name = deployment_name
         self.replica_id = replica_id

@@ -20,6 +20,35 @@ class DeploymentResponse:
         self._ref = ref
         self._done = False
 
+    def __await__(self):
+        """Awaitable inside async deployments (reference:
+        DeploymentResponse.__await__ — the composition data path). Runs
+        the same drain-retry protocol as result(), without blocking the
+        replica's event loop."""
+        return self._async_result().__await__()
+
+    async def _async_result(self) -> Any:
+        import asyncio
+
+        from ray_tpu.serve.exceptions import ReplicaDrainingError
+
+        while True:
+            try:
+                value = await asyncio.wrap_future(self._ref.future())
+                self._complete()
+                return value
+            except ReplicaDrainingError:
+                self._complete()
+                self._handle._router.invalidate()
+                new = self._handle.remote_method(
+                    self._handle._method_name, self._args, self._kwargs)
+                self._replica_id = new._replica_id
+                self._ref = new._ref
+                self._done = False
+            except BaseException:
+                self._complete()
+                raise
+
     def result(self, timeout_s: Optional[float] = None) -> Any:
         import ray_tpu
         from ray_tpu.serve.exceptions import ReplicaDrainingError

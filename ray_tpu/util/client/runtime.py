@@ -47,9 +47,11 @@ class ClientRuntime:
     def _pack(self, value) -> bytes:
         return serialization.serialize(value).to_bytes()
 
-    def _ref_from_hex(self, ref_hex: str) -> ObjectRef:
-        return ObjectRef(ObjectID(bytes.fromhex(ref_hex)),
-                         owner=self.proxy_address, runtime=self)
+    def _ref_from_wire(self, info) -> ObjectRef:
+        # `info` = {"id", "owner"}: the owner is the proxy RUNTIME's
+        # address so refs embedded in args resolve cluster-side.
+        return ObjectRef(ObjectID(bytes.fromhex(info["id"])),
+                         owner=info.get("owner"), runtime=self)
 
     def _ensure_registered(self, kind: str, obj) -> str:
         blob = self._pack(obj)
@@ -65,8 +67,8 @@ class ClientRuntime:
     def put(self, value: Any) -> ObjectRef:
         if isinstance(value, ObjectRef):
             raise TypeError("Calling put() on an ObjectRef is not allowed.")
-        ref_hex = self._call("client_put", blob=self._pack(value))
-        return self._ref_from_hex(ref_hex)
+        return self._ref_from_wire(
+            self._call("client_put", blob=self._pack(value)))
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -129,7 +131,7 @@ class ClientRuntime:
             "client_task", fn_key=fn_key,
             args_blob=self._pack((tuple(args), dict(kwargs))),
             opts_blob=self._pack(opts))
-        refs = [self._ref_from_hex(r) for r in ref_ids]
+        refs = [self._ref_from_wire(r) for r in ref_ids]
         if getattr(opts, "num_returns", 1) == 0:
             return None
         return refs[0] if len(refs) == 1 else refs
@@ -154,7 +156,7 @@ class ClientRuntime:
             method_name=method_name,
             args_blob=self._pack((tuple(args), dict(kwargs))),
             opts_blob=self._pack(opts))
-        refs = [self._ref_from_hex(r) for r in ref_ids]
+        refs = [self._ref_from_wire(r) for r in ref_ids]
         if not refs:
             return None
         return refs[0] if len(refs) == 1 else refs

@@ -51,10 +51,13 @@ class ClientProxy:
         await self._rpc.stop()
 
     # -- plumbing -------------------------------------------------------
-    def _track(self, ref, conn: ServerConnection) -> str:
+    def _track(self, ref, conn: ServerConnection) -> dict:
         self._refs[ref.hex()] = (ref, conn)
         conn.metadata.setdefault("client_refs", set()).add(ref.hex())
-        return ref.hex()
+        # The TRUE owner address rides along: client-held refs passed
+        # back as task args must resolve against the real owner (the
+        # proxy's runtime), not the proxy's RPC endpoint.
+        return {"id": ref.hex(), "owner": ref._owner}
 
     def _ref(self, ref_id: str):
         entry = self._refs.get(ref_id)
@@ -71,9 +74,11 @@ class ClientProxy:
         # Refs NESTED in returned values must be tracked (pinned) too, or
         # the client gets a ref the proxy doesn't know and the object's
         # refcount can hit zero while the client still holds it.
+        def pin(r):
+            self._track(r, conn)
+
         return serialization.serialize(
-            value, ref_serializer=lambda r: self._track(r, conn)
-        ).to_bytes()
+            value, ref_serializer=pin).to_bytes()
 
     async def on_client_disconnect(self, conn: ServerConnection) -> None:
         """Release everything the vanished client owned."""
@@ -175,7 +180,10 @@ class ClientProxy:
         actor_id = handle._actor_id.hex() if hasattr(
             handle._actor_id, "hex") else str(handle._actor_id)
         self._actors[actor_id] = (handle, conn)
-        conn.metadata.setdefault("client_actors", set()).add(actor_id)
+        if getattr(opts, "lifetime", None) != "detached":
+            # Detached actors outlive their creator BY CONTRACT — never
+            # reap them with the connection.
+            conn.metadata.setdefault("client_actors", set()).add(actor_id)
         return {"actor_id": actor_id,
                 "class_name": handle._class_name,
                 "meta": serialization.serialize(

@@ -277,3 +277,45 @@ def test_delete_deployment(serve_instance):
     assert handle.remote(None).result(timeout_s=30) == 1
     serve.delete("Tmp")
     assert "Tmp" not in serve.status()
+
+
+def test_model_composition(serve_instance):
+    """Deployment graph: ingress holds a handle to a child deployment
+    (reference: serve deployment_graph_build + handle-injection); the
+    child response is awaitable inside the async ingress."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, doubler, bias):
+            self.doubler = doubler
+            self.bias = bias
+
+        async def __call__(self, x):
+            y = await self.doubler.remote(x)
+            return y + self.bias
+
+    handle = serve.run(Ingress.bind(Doubler.bind(), 3), name="comp",
+                       route_prefix="/comp")
+    assert handle.remote(5).result(timeout_s=60) == 13
+    # The child is addressable on its own too.
+    child = serve.get_deployment_handle("Doubler")
+    assert child.remote(7).result(timeout_s=60) == 14
+    # And the composed app serves over HTTP.
+    import json
+    import urllib.request
+
+    port = serve.start()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/comp", data=json.dumps(4).encode(),
+        headers={"content-type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert json.loads(resp.read()) == 11
+    serve.delete("Ingress")
+    serve.delete("Doubler")
