@@ -424,16 +424,26 @@ class Store {
           // (flush owns cleanup and will discard its now-stale file).
           uint8_t* buf = nullptr;
           bool writing = false;
-          auto owned = pending_spills_.end();
-          for (auto pit = pending_spills_.begin();
-               pit != pending_spills_.end(); ++pit) {
-            if (pit->oid == oid) {
-              buf = pit->buf;
-              writing = pit->writing;
-              owned = pit;
+          for (auto& ps : pending_spills_) {
+            if (ps.oid == oid) {
+              buf = ps.buf;
+              writing = ps.writing;
               break;
             }
           }
+          // NOTE: ensure_space below can push_back into
+          // pending_spills_ (spilling other victims), which can
+          // reallocate the deque's internal map — any iterator taken
+          // above would dangle (ASan-caught UAF). Erase by re-scan.
+          auto erase_item = [&]() {
+            for (auto pit = pending_spills_.begin();
+                 pit != pending_spills_.end(); ++pit) {
+              if (pit->oid == oid && pit->buf == buf) {
+                pending_spills_.erase(pit);
+                return;
+              }
+            }
+          };
           if (buf == nullptr) return nullptr;  // shouldn't happen
           if (!ensure_space(e.size) || !map_segment(e, /*create=*/true)) {
             // Bytes are unrecoverable: drop the entry so contains()
@@ -441,16 +451,16 @@ class Store {
             // reconstruct via lineage).  A writing item's buffer is
             // left for flush_spills to reclaim.
             if (!writing) {
+              erase_item();
               free(buf);
-              pending_spills_.erase(owned);
             }
             drop(it, /*unlink_shm=*/true, /*remove_spill=*/false);
             return nullptr;
           }
           memcpy(e.base, buf, e.size);
           if (!writing) {
+            erase_item();
             free(buf);
-            pending_spills_.erase(owned);
           }
           used_ += e.size;
           e.state = St::RESIDENT;

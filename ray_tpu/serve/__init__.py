@@ -96,16 +96,33 @@ class _HandleMarker:
 
 def _map_tree(value, leaf_fn):
     """Shared structural walk for handle substitution/resolution —
-    one walker so deploy-side and replica-side can't drift."""
+    one walker so deploy-side and replica-side can't drift. Unchanged
+    subtrees are returned AS-IS (identity), so container subclasses
+    (namedtuples, OrderedDict, user types) pass through untouched
+    unless they actually contain a marker/application."""
     mapped = leaf_fn(value)
     if mapped is not value:
         return mapped
     if isinstance(value, tuple):
-        return tuple(_map_tree(v, leaf_fn) for v in value)
+        items = [_map_tree(v, leaf_fn) for v in value]
+        if all(a is b for a, b in zip(items, value)):
+            return value
+        if hasattr(value, "_fields"):      # namedtuple
+            return type(value)(*items)
+        return tuple(items)
     if isinstance(value, list):
-        return [_map_tree(v, leaf_fn) for v in value]
+        items = [_map_tree(v, leaf_fn) for v in value]
+        if all(a is b for a, b in zip(items, value)):
+            return value
+        return items
     if isinstance(value, dict):
-        return {k: _map_tree(v, leaf_fn) for k, v in value.items()}
+        items = {k: _map_tree(v, leaf_fn) for k, v in value.items()}
+        if all(items[k] is value[k] for k in value):
+            return value
+        try:
+            return type(value)(items)
+        except Exception:
+            return items
     return value
 
 
@@ -193,7 +210,9 @@ def run(app: Application, *, name: str = "default",
 
     deployed: list = []
     assigned: dict = {}     # id(Application) -> deployed name (diamonds)
-    used_names: set = set()
+    # The ROOT's name is reserved up front: a child of the same class
+    # must uniquify, not overwrite the ingress (or vice versa).
+    used_names: set = {app.deployment.name}
 
     def deploy_child(child: Application) -> str:
         if id(child) in assigned:

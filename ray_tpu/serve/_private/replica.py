@@ -22,12 +22,17 @@ def _resolve_handle_markers(value):
     from ray_tpu.serve import _HandleMarker, _map_tree
     from ray_tpu.serve.handle import DeploymentHandle
 
-    def leaf(v):
-        if isinstance(v, _HandleMarker):
-            import ray_tpu
-            from ray_tpu.serve._private.controller import CONTROLLER_NAME
+    controller = None  # one GCS lookup per resolution pass, not per marker
 
-            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    def leaf(v):
+        nonlocal controller
+        if isinstance(v, _HandleMarker):
+            if controller is None:
+                import ray_tpu
+                from ray_tpu.serve._private.controller import (
+                    CONTROLLER_NAME)
+
+                controller = ray_tpu.get_actor(CONTROLLER_NAME)
             return DeploymentHandle(v.deployment_name, controller)
         return v
 

@@ -101,7 +101,7 @@ class ClientProxy:
 
     # -- objects --------------------------------------------------------
     async def handle_client_put(self, conn: ServerConnection, *,
-                                blob: bytes) -> str:
+                                blob: bytes) -> dict:
         value = self._deserialize_args(blob)
         ref = self._rt.put(value)
         return self._track(ref, conn)
