@@ -5,6 +5,8 @@ LearnerGroup / EnvRunner / Algorithm); the old RolloutWorker/Policy stack
 and the torch/tf paths are intentionally not reproduced (SURVEY §7.9).
 """
 
+from ray_tpu.rllib.algorithms.dqn import (DQN, DQNConfig, DQNLearner,
+                                          ReplayBuffer)
 from ray_tpu.rllib.algorithms.impala import (APPO, APPOConfig, IMPALA,
                                              IMPALAConfig)
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
@@ -18,5 +20,6 @@ from ray_tpu.rllib.env.env_runner import (SingleAgentEnvRunner,
 __all__ = [
     "PPO", "PPOConfig", "PPOLearner", "LearnerGroup",
     "IMPALA", "IMPALAConfig", "APPO", "APPOConfig", "ImpalaLearner",
+    "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer",
     "DiscreteMLPModule", "SingleAgentEnvRunner", "compute_gae",
 ]

@@ -54,6 +54,7 @@ class SingleAgentEnvRunner:
         act_buf = np.zeros((T, n_envs), np.int32)
         rew_buf = np.zeros((T, n_envs), np.float32)
         done_buf = np.zeros((T, n_envs), np.float32)
+        term_buf = np.zeros((T, n_envs), np.float32)
         logp_buf = np.zeros((T, n_envs), np.float32)
         val_buf = np.zeros((T, n_envs), np.float32)
         # Time-limit truncations are NOT terminations: GAE must bootstrap
@@ -81,6 +82,7 @@ class SingleAgentEnvRunner:
                 self._episode_return[i] += r
                 done = term or trunc
                 done_buf[t, i] = float(done)
+                term_buf[t, i] = float(term)
                 if done:
                     if trunc and not term:
                         trunc_events.append((t, i, np.asarray(o)))
@@ -94,22 +96,37 @@ class SingleAgentEnvRunner:
         _, last_values = self._apply(self.params,
                                      self.obs.astype(np.float32))
         trunc_values = np.zeros((T, n_envs), np.float32)
+        trunc_t = np.zeros((len(trunc_events),), np.int32)
+        trunc_env = np.zeros((len(trunc_events),), np.int32)
+        trunc_obs = (np.stack([o for _, _, o in trunc_events]
+                              ).astype(np.float32) if trunc_events
+                     else np.zeros((0,) + self.obs.shape[1:], np.float32))
         if trunc_events:
-            finals = np.stack([o for _, _, o in trunc_events]
-                              ).astype(np.float32)
-            _, v_final = self._apply(self.params, finals)
+            _, v_final = self._apply(self.params, trunc_obs)
             v_final = np.asarray(v_final)
             for k, (t, i, _) in enumerate(trunc_events):
                 trunc_values[t, i] = v_final[k]
+                trunc_t[k] = t
+                trunc_env[k] = i
         return {
             "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
-            "dones": done_buf, "logp_old": logp_buf, "values": val_buf,
+            "dones": done_buf,
+            # Truncation is NOT termination: off-policy targets must
+            # bootstrap through time limits (terminateds masks, dones
+            # marks episode boundaries).
+            "terminateds": term_buf,
+            "logp_old": logp_buf, "values": val_buf,
             "last_values": np.asarray(last_values),
             # The raw post-fragment observation: off-policy learners
             # (IMPALA v-trace) bootstrap from the LEARNER's value of this
             # state, not the actor's stale `last_values`.
             "final_obs": self.obs.astype(np.float32),
             "trunc_values": trunc_values,
+            # Sparse truncation records: step, env, and the TRUE final
+            # observation the time limit cut off (replay learners
+            # bootstrap from it; GAE uses trunc_values instead).
+            "trunc_t": trunc_t, "trunc_env": trunc_env,
+            "trunc_obs": trunc_obs,
             "episode_returns": np.array(list(self._completed)),
         }
 
