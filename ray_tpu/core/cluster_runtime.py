@@ -360,6 +360,22 @@ class ClusterRuntime:
         return cls(gcs_address=address, raylet_address=head["address"],
                    namespace=namespace, node_id=head["node_id"])
 
+    def check_alive(self) -> bool:
+        """Cheap liveness probe: is our GCS still answering?
+
+        Used by init(ignore_reinit_error=True) to avoid silently reusing a
+        runtime whose cluster has been torn down (stale function caches,
+        leaked leases). Reference contract: ray.init reconnects rather than
+        reusing a dead worker (_private/worker.py:1152).
+        """
+        if self._shutdown:
+            return False
+        try:
+            self._loop.run(self._gcs.get_nodes(), timeout=5)
+            return True
+        except Exception:
+            return False
+
     def shutdown(self) -> None:
         if self._shutdown:
             return

@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import hashlib
 import threading
-from typing import Any, Callable, Dict
+import weakref
+from typing import Any, Callable, Dict, Tuple
 
 import cloudpickle
 
@@ -19,28 +20,43 @@ def _hash_blob(blob: bytes) -> str:
     return hashlib.sha256(blob).hexdigest()[:32]
 
 
-
-
 class FunctionManager:
     def __init__(self, kv_put, kv_get):
         """kv_put(key: str, value: bytes, overwrite) / kv_get(key) -> bytes;
         both synchronous callables provided by the runtime."""
         self._kv_put = kv_put
         self._kv_get = kv_get
-        self._exported: Dict[int, str] = {}   # id(obj) -> key
+        # id(obj) -> (weakref(obj), key). The weakref is re-verified on every
+        # hit: CPython recycles ids of collected objects, so a bare id-keyed
+        # cache can hand a *different* closure at a reused address the old
+        # function's blob (wrong-code execution). Content addressing is the
+        # source of truth (reference: _private/function_manager.py:61,228);
+        # this map is only a skip-the-pickle fast path.
+        self._exported: Dict[int, Tuple[Any, str]] = {}
         self._cache: Dict[str, Any] = {}      # key -> callable/class
         self._lock = threading.Lock()
 
     def export(self, obj: Callable) -> str:
+        oid = id(obj)
         with self._lock:
-            key = self._exported.get(id(obj))
-            if key is not None:
-                return key
+            entry = self._exported.get(oid)
+            if entry is not None:
+                ref, key = entry
+                if ref() is obj:
+                    return key
+                del self._exported[oid]
         blob = cloudpickle.dumps(obj)
         key = f"fn:{_hash_blob(blob)}"
         self._kv_put(key, blob, False)
         with self._lock:
-            self._exported[id(obj)] = key
+            try:
+                # Eviction callback bounds _exported: once the object is
+                # collected its entry can never validate again, so drop it.
+                self._exported[oid] = (
+                    weakref.ref(obj, lambda _, oid=oid:
+                                self._exported.pop(oid, None)), key)
+            except TypeError:
+                pass  # not weakref-able: no fast path, re-pickle each time
             self._cache[key] = obj
         return key
 

@@ -121,6 +121,7 @@ class Raylet:
         # that dies (not merely times out) can never use or return its
         # grants, so disconnect reclaims them.
         self._lease_conns: Dict[str, tuple] = {}
+        self._stopping = False
 
     @property
     def address(self) -> str:
@@ -145,19 +146,33 @@ class Raylet:
                     self.address)
 
     async def stop(self) -> None:
+        # Gate worker (re)spawning first: a leased worker dying mid-stop
+        # otherwise triggers _try_dispatch -> _spawn_worker, and the fresh
+        # worker outlives us stuck in a connect-retry loop (orphan).
+        self._stopping = True
         for t in self._tasks + list(self._monitors.values()):
             t.cancel()
         for w in self._workers.values():
             if w.proc.poll() is None:
                 w.proc.terminate()
+        # One shared grace window for the whole pool: the supervisor
+        # SIGKILLs *us* after ~3 s, and any worker still alive at that
+        # point would be orphaned — so escalate to SIGKILL well inside
+        # that budget rather than waiting per worker.
+        deadline = time.monotonic() + 1.5
         for w in self._workers.values():
             try:
-                w.proc.wait(timeout=2)
+                w.proc.wait(timeout=max(0.05, deadline - time.monotonic()))
             except Exception:
                 w.proc.kill()
         self.store.shutdown()
         await self._rpc.stop()
         await self._gcs.close()
+        # Final sweep: anything that slipped in between the first loop and
+        # the RPC server going down dies hard.
+        for w in self._workers.values():
+            if w.proc.poll() is None:
+                w.proc.kill()
 
     async def _register_with_gcs(self) -> None:
         reply = await self._gcs.register_node(
@@ -267,7 +282,9 @@ class Raylet:
     # ------------------------------------------------------------------
     # worker pool (reference: worker_pool.h)
     # ------------------------------------------------------------------
-    def _spawn_worker(self) -> _Worker:
+    def _spawn_worker(self) -> Optional[_Worker]:
+        if self._stopping:
+            return None
         import uuid
 
         worker_id = uuid.uuid4().hex
@@ -516,6 +533,8 @@ class Raylet:
         return taken
 
     def _try_dispatch(self) -> None:
+        if self._stopping:
+            return
         made_progress = True
         while made_progress and self._pending:
             made_progress = False

@@ -40,6 +40,28 @@ def is_initialized() -> bool:
     return _runtime is not None
 
 
+def _runtime_is_alive(rt) -> bool:
+    """Probe a cached runtime before ignore_reinit_error reuses it.
+
+    Two probe attempts before declaring death: a single short timeout
+    would tear down a *healthy* cluster whose GCS is momentarily loaded
+    (observed: heavy suites slow this box 30x), and teardown here is
+    destructive — it kills the user's live actors.
+    """
+    if getattr(rt, "_shutdown", False):
+        return False
+    check = getattr(rt, "check_alive", None)
+    if check is None:
+        return True
+    for _ in range(2):
+        try:
+            if check():
+                return True
+        except Exception:
+            pass
+    return False
+
+
 def init(address: Optional[str] = None, *,
          num_cpus: Optional[int] = None,
          num_gpus: Optional[int] = None,
@@ -59,10 +81,21 @@ def init(address: Optional[str] = None, *,
     with _global_lock:
         if _runtime is not None:
             if ignore_reinit_error:
-                return _runtime
-            raise RuntimeError(
-                "ray_tpu.init() was already called. Pass "
-                "ignore_reinit_error=True to ignore.")
+                if _runtime_is_alive(_runtime):
+                    return _runtime
+                # The cached runtime is dead (its cluster was torn down or
+                # the GCS is unreachable): reusing it would hand out stale
+                # state — function caches, leaked leases — from a previous
+                # session. Discard it and bring up a fresh one.
+                try:
+                    _runtime.shutdown()
+                except Exception:
+                    pass
+                _runtime = None
+            else:
+                raise RuntimeError(
+                    "ray_tpu.init() was already called. Pass "
+                    "ignore_reinit_error=True to ignore.")
         from ray_tpu.core.config import ray_config
         ray_config().apply_system_config(_system_config)
 

@@ -64,7 +64,21 @@ def main() -> None:
         if not runtime._raylet.connected:
             logging.info("raylet connection lost; exiting")
             break
-    runtime.shutdown()
+    # Graceful shutdown can wedge on non-daemon task threads (a user task
+    # blocked in get() against a dying cluster); the process must still
+    # exit promptly or it orphans past the raylet's kill window. Arm a
+    # hard-exit backstop, attempt the clean path, then force the issue.
+    import os
+
+    killer = threading.Timer(3.0, lambda: os._exit(1))
+    killer.daemon = True
+    killer.start()
+    try:
+        runtime.shutdown()
+    except BaseException:
+        logging.exception("shutdown failed")
+        os._exit(1)
+    os._exit(0)
 
 
 if __name__ == "__main__":

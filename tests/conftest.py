@@ -16,7 +16,87 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+import signal  # noqa: E402
+import time  # noqa: E402
+
 import pytest  # noqa: E402
+
+# Every process the runtime spawns runs `python -m <one of these>`. Matching
+# the exact ("-m", module) argv pair keeps the reaper from ever touching an
+# unrelated process whose command line merely *mentions* ray_tpu.
+_RAY_SPAWNED_MODULES = {
+    "ray_tpu.core.raylet",
+    "ray_tpu.core.gcs.server",
+    "ray_tpu.core.worker_main",
+    "ray_tpu.dashboard",
+    "ray_tpu.util.client.server",
+}
+
+# Daemons started by THIS pytest session inherit this marker; the reaper
+# only touches processes carrying it, so a developer's live dev cluster on
+# the same box is never killed by a test run.
+_SESSION_MARKER = f"RAY_TPU_TEST_SESSION={os.getpid()}"
+os.environ["RAY_TPU_TEST_SESSION"] = str(os.getpid())
+
+
+def _ray_tpu_processes(any_session: bool = False):
+    found = []
+    for pid_dir in os.listdir("/proc"):
+        if not pid_dir.isdigit():
+            continue
+        pid = int(pid_dir)
+        if pid == os.getpid():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                argv = [a.decode("utf-8", "replace")
+                        for a in f.read().split(b"\0") if a]
+        except OSError:
+            continue
+        hit = None
+        for i, arg in enumerate(argv[:-1]):
+            if arg == "-m" and argv[i + 1] in _RAY_SPAWNED_MODULES:
+                hit = " ".join(argv[i:i + 4])
+                break
+        if hit is None:
+            continue
+        if not any_session:
+            try:
+                with open(f"/proc/{pid}/environ", "rb") as f:
+                    env = f.read().decode("utf-8", "replace")
+            except OSError:
+                continue
+            if _SESSION_MARKER not in env.split("\0"):
+                continue
+        found.append((pid, hit))
+    return found
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _no_leaked_clusters(request):
+    """Fail any module that leaks runtime processes (raylets, GCS, workers).
+
+    Mirrors the hygiene the reference enforces via per-test cluster fixtures
+    (python/ray/tests/conftest.py:410): every module must tear its cluster
+    all the way down. Leaked processes are killed so they can't poison the
+    rest of the suite, then the module is failed loudly.
+    """
+    yield
+    # Give just-shut-down daemons a moment to exit before declaring a leak.
+    leaked = _ray_tpu_processes()
+    deadline = time.monotonic() + 5.0
+    while leaked and time.monotonic() < deadline:
+        time.sleep(0.25)
+        leaked = _ray_tpu_processes()
+    if leaked:
+        for pid, _ in leaked:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+        pytest.fail(
+            f"{request.module.__name__} leaked ray_tpu processes "
+            f"(killed): {leaked}", pytrace=False)
 
 
 @pytest.fixture(autouse=True, scope="session")

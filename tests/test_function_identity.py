@@ -1,0 +1,90 @@
+"""Function-export identity must be content-addressed, never id()-based.
+
+Round-3 regression: `FunctionManager.export` cached by `id(obj)`; when two
+closures pickled to the same blob the second replaced the first in the
+key->obj cache, dropping the only pin on the first. CPython then recycled
+the freed function's address for a *new* closure, which silently resolved
+to the old function's blob — workers executed the wrong code
+(reference contract: _private/function_manager.py:61,228 — content hash).
+"""
+
+import gc
+
+import cloudpickle
+
+from ray_tpu.core.function_manager import FunctionManager
+
+
+def _make_manager():
+    kv = {}
+    fm = FunctionManager(
+        kv_put=lambda k, v, overwrite: kv.__setitem__(k, v),
+        kv_get=kv.get)
+    return fm, kv
+
+
+def _adder(n):
+    def f(x):
+        return x + n
+    return f
+
+
+def test_same_blob_then_gc_then_new_closure():
+    fm, kv = _make_manager()
+    # Two closures with identical blobs share a key; exporting the second
+    # used to drop the cache pin on the first.
+    f1 = _adder(7)
+    f2 = _adder(7)
+    k1 = fm.export(f1)
+    k2 = fm.export(f2)
+    assert k1 == k2
+    del f1, f2
+    gc.collect()
+    # Allocate fresh closures — some will land on the recycled addresses of
+    # f1/f2. Every export must still resolve to a blob with the closure's
+    # own behavior, not the stale key at that address.
+    for n in range(50):
+        g = _adder(1000 + n)
+        key = fm.export(g)
+        loaded = cloudpickle.loads(kv[key])
+        assert loaded(1) == 1001 + n, (
+            f"export({n}) resolved to the wrong function blob")
+        del g
+        gc.collect()
+
+
+def test_identical_object_fast_path_still_works():
+    fm, kv = _make_manager()
+    f = _adder(3)
+    k1 = fm.export(f)
+    k2 = fm.export(f)
+    assert k1 == k2
+    assert cloudpickle.loads(kv[k1])(1) == 4
+
+
+def test_reinit_discards_dead_runtime(monkeypatch):
+    """init(ignore_reinit_error=True) must verify the cached runtime is
+    alive instead of blindly reusing it (round-3 aggravator:
+    core/worker.py:59-62 returned a stale `_runtime` across test modules)."""
+    from ray_tpu.core import worker
+
+    class DeadRuntime:
+        def __init__(self):
+            self.shutdown_called = False
+
+        def check_alive(self):
+            return False
+
+        def shutdown(self):
+            self.shutdown_called = True
+
+    dead = DeadRuntime()
+    old = worker._runtime
+    try:
+        worker._runtime = dead
+        rt = worker.init(local_mode=True, ignore_reinit_error=True)
+        assert rt is not dead
+        assert dead.shutdown_called
+        worker.shutdown()
+    finally:
+        worker._runtime = old
