@@ -469,12 +469,27 @@ class ClusterRuntime:
         # owner process's own local references (reference:
         # reference_count.h borrowing protocol). The owner's escrow pin
         # (_escrow_pin) bridges the gap until this lands. Refs inside
-        # TASK ARGS skip this — the submitter pins them for the task's
-        # whole duration, and two extra owner RPCs per argument would
-        # tax the hot path.
-        if getattr(_deser_ctx, "suppress_borrow", False):
-            return
+        # TASK ARGS take a *local-only* pin instead — the submitter pins
+        # them for the task's whole duration, so no owner RPC is needed
+        # on the hot path; if the task retains the ref past completion,
+        # _commit_arg_borrows upgrades the pin to a real owner-registered
+        # borrow before the reply releases the submitter's pin
+        # (reference: the borrowed-refs report in the task reply,
+        # reference_count.h).
         owner = ref._owner
+        if getattr(_deser_ctx, "suppress_borrow", False):
+            if isinstance(owner, str) and owner != self.address:
+                with self._borrowed_lock:
+                    rec = self._borrowed.get(oid)
+                    if rec is None:
+                        # [owner, local count, owner ACKed the borrow]
+                        self._borrowed[oid] = [owner, 1, False]
+                    else:
+                        rec[1] += 1
+                collected = getattr(_deser_ctx, "arg_refs", None)
+                if collected is not None:
+                    collected.append((oid, owner))
+            return
         if not isinstance(owner, str) or owner == self.address:
             return
         register = False
@@ -539,6 +554,13 @@ class ClusterRuntime:
         with self._owned_lock:
             entry = self._owned.get(oid)
             if entry is None:
+                # Likely an escrow window that lapsed before the consumer
+                # first deserialized the containing object — the borrow
+                # cannot be honored and the consumer's get will fail.
+                logger.warning(
+                    "register_borrow for already-freed object %s "
+                    "(escrow window borrow_escrow_s=%.0fs lapsed?)",
+                    oid[:16], ray_config().borrow_escrow_s)
                 return False
             entry.refcount += 1
         return True
@@ -1904,26 +1926,100 @@ class ClusterRuntime:
             self._job_envs_applied.add(job_id)
 
     def _resolve_task_args(self, args_blob: bytes):
+        """Returns (args, kwargs, arg_refs) where arg_refs is the list of
+        (oid, owner) pairs for every ref deserialized from the payload —
+        the input for _commit_arg_borrows at task completion."""
         _deser_ctx.suppress_borrow = True
+        _deser_ctx.arg_refs = []
         try:
             args, kwargs = self._deserialize_payload(args_blob)
         finally:
             _deser_ctx.suppress_borrow = False
+            arg_refs = _deser_ctx.arg_refs
+            _deser_ctx.arg_refs = None
         args = [self.get(a) if isinstance(a, ObjectRef) else a for a in args]
         kwargs = {k: self.get(v) if isinstance(v, ObjectRef) else v
                   for k, v in kwargs.items()}
-        return args, kwargs
+        return args, kwargs, arg_refs
 
-    # How long a result-embedded ref stays escrow-pinned in its owner
-    # process, bridging the gap between shipping the result and the
-    # consumer's register_borrow (reference: the borrowing protocol of
-    # reference_count.h; here time-bounded rather than tracked per
-    # containing object).
-    BORROW_ESCROW_S = 600.0
+    def _commit_arg_borrows(self, arg_refs) -> None:
+        """Upgrade still-held arg-ref pins to owner-registered borrows.
+
+        Called after task completion with args/kwargs/value dropped: any
+        arg oid whose local pin count survived is retained (actor state,
+        a live generator, result escrow) and the owner must count the
+        borrow BEFORE our reply lets the submitter's pin lapse, or the
+        owner may free the object while we still hold it (reference:
+        reference_count.h — borrowed refs are reported in the task
+        reply). Synchronous on purpose; costs RPCs only for tasks that
+        actually retain arg refs.
+        """
+        pending = []  # (oid, owner, rec) needing an owner round-trip
+        seen = set()
+        for oid, owner in arg_refs:
+            if oid in seen:
+                continue
+            seen.add(oid)
+            with self._borrowed_lock:
+                rec = self._borrowed.get(oid)
+                if rec is None or rec[2]:
+                    continue  # fully released during the task / registered
+            pending.append((oid, owner, rec))
+        if not pending:
+            return
+
+        async def _register(oid, owner):
+            client = await self._worker_client(owner)
+            return bool(await client.call("register_borrow", oid=oid,
+                                          timeout=30.0))
+
+        async def _register_all():
+            # Concurrent: the RPCs are independent, and a dead owner must
+            # cost one timeout total, not one per retained oid.
+            return await asyncio.gather(
+                *(_register(oid, owner) for oid, owner, _ in pending),
+                return_exceptions=True)
+
+        try:
+            results = self._loop.run(_register_all(), timeout=35)
+        except Exception:
+            results = [False] * len(pending)
+        for (oid, owner, rec), res in zip(pending, results):
+            ok = res is True
+            if not ok:
+                # The retained ref is now unprotected: once the
+                # submitter's pin lapses the owner may free the object
+                # and a later get on it will fail. Leave a trail.
+                logger.warning(
+                    "could not register retained arg borrow for %s with "
+                    "owner %s (%s); object may be freed while still held",
+                    oid[:16], owner,
+                    res if isinstance(res, Exception) else "refused")
+            with self._borrowed_lock:
+                cur = self._borrowed.get(oid)
+                if cur is rec:
+                    if ok:
+                        rec[2] = True
+                    continue
+            if ok:
+                # Pin released while our registration was in flight: the
+                # owner counted us, so compensate.
+                async def _release(oid=oid, owner=owner):
+                    try:
+                        client = await self._worker_client(owner)
+                        await client.call("release_borrow", oid=oid,
+                                          timeout=30.0)
+                    except Exception:
+                        pass
+
+                self._loop.spawn(_release())
 
     def _escrow_pin(self, ref) -> None:
         """Pin a ref embedded in an outgoing result until consumers had
-        ample time to register their borrow."""
+        ample time to register their borrow (window: config
+        borrow_escrow_s; reference: the borrowing protocol of
+        reference_count.h, here time-bounded rather than tracked per
+        containing object)."""
         oid = ref.hex()
         with self._owned_lock:
             known = oid in self._owned
@@ -1939,7 +2035,7 @@ class ClusterRuntime:
             self.on_ref_deserialized(ref)
 
         async def _release_later(object_id=ref.id()):
-            await asyncio.sleep(self.BORROW_ESCROW_S)
+            await asyncio.sleep(ray_config().borrow_escrow_s)
             self.remove_local_reference(object_id)
 
         self._loop.spawn(_release_later())
@@ -1973,6 +2069,8 @@ class ClusterRuntime:
                                 job_id=spec.get("job_id"))
         self._running_task_threads[task_id] = threading.get_ident()
         ok = False
+        arg_refs: List[tuple] = []
+        args = kwargs = value = None
         try:
             if task_id in self._cancelled_pending:
                 raise TaskCancelledError(task_id)
@@ -1983,8 +2081,9 @@ class ClusterRuntime:
 
                 apply_runtime_env(self, spec["runtime_env"])
             fn = self._fn.fetch(spec["fn_key"])
-            args, kwargs = self._resolve_task_args(spec["args"])
+            args, kwargs, arg_refs = self._resolve_task_args(spec["args"])
             value = fn(*args, **kwargs)
+            args = kwargs = None
             results = self._package_returns(task_id, num_returns, name,
                                             value)
             ok = True
@@ -1992,6 +2091,11 @@ class ClusterRuntime:
             self._die_if_orphaned()
             results = self._package_error(task_id, num_returns, name, e)
         finally:
+            # Drop frame refs to args/value so only genuinely retained
+            # arg refs still hold pins, then upgrade those to real
+            # borrows before the reply releases the submitter's pin.
+            args = kwargs = value = None
+            self._commit_arg_borrows(arg_refs)
             self._running_task_threads.pop(task_id, None)
             self._cancelled_pending.discard(task_id)
             self._record_task_event(
@@ -2065,16 +2169,21 @@ class ClusterRuntime:
         task_id = spec["task_id"]
 
         def run() -> Optional[bytes]:
+            arg_refs: List[tuple] = []
+            args = kwargs = it = None
             try:
                 self._ensure_job_env(spec.get("job_id"))
                 if actor:
                     method = getattr(self._actor_instance, spec["method"])
-                    args, kwargs = self._resolve_task_args(spec["args"])
+                    args, kwargs, arg_refs = self._resolve_task_args(
+                        spec["args"])
                     it = method(*args, **kwargs)
                 else:
                     fn = self._fn.fetch(spec["fn_key"])
-                    args, kwargs = self._resolve_task_args(spec["args"])
+                    args, kwargs, arg_refs = self._resolve_task_args(
+                        spec["args"])
                     it = fn(*args, **kwargs)
+                args = kwargs = None
                 idx = 0
                 for item in it:
                     idx += 1
@@ -2092,6 +2201,9 @@ class ClusterRuntime:
                            else RayTaskError.from_exception(
                                spec.get("name", "task"), e))
                 return serialization.serialize_error(wrapped).to_bytes()
+            finally:
+                args = kwargs = it = None
+                self._commit_arg_borrows(arg_refs)
 
         pool = (self._actor_executor if actor and self._actor_executor
                 else self._exec_pool)
@@ -2139,8 +2251,12 @@ class ClusterRuntime:
 
                     apply_runtime_env(self, runtime_env)
                 cls = self._fn.fetch(cls_key)
-                rargs, rkwargs = self._resolve_task_args(args)
+                rargs, rkwargs, arg_refs = self._resolve_task_args(args)
                 self._actor_instance = cls(*rargs, **rkwargs)
+                rargs = rkwargs = None
+                # Constructor args stored on the instance are the classic
+                # retained-arg case: commit before the creation reply.
+                self._commit_arg_borrows(arg_refs)
                 is_async = any(
                     _inspect.iscoroutinefunction(m)
                     or _inspect.isasyncgenfunction(m)
@@ -2190,11 +2306,13 @@ class ClusterRuntime:
                                 actor_id=spec.get("actor_id"))
         self._running_task_threads[task_id] = threading.get_ident()
         ok = False
+        arg_refs: List[tuple] = []
+        args = kwargs = value = None
         try:
             if task_id in self._cancelled_pending:
                 raise TaskCancelledError(task_id)
             self._ensure_job_env(spec.get("job_id"))
-            args, kwargs = self._resolve_task_args(spec["args"])
+            args, kwargs, arg_refs = self._resolve_task_args(spec["args"])
             if spec["method"] == "__ray_call__":
                 # fn(actor_instance, *args): the system method for running
                 # arbitrary code against a live actor (reference:
@@ -2214,6 +2332,7 @@ class ClusterRuntime:
                     raise TaskCancelledError(task_id)
                 finally:
                     self._running_task_cfuts.pop(task_id, None)
+            args = kwargs = None
             results = self._package_returns(task_id, num_returns, name,
                                             value)
             ok = True
@@ -2221,6 +2340,10 @@ class ClusterRuntime:
             self._die_if_orphaned()
             results = self._package_error(task_id, num_returns, name, e)
         finally:
+            # See _execute_task: only genuinely retained arg refs (here
+            # usually actor state) must survive as registered borrows.
+            args = kwargs = value = None
+            self._commit_arg_borrows(arg_refs)
             self._running_task_threads.pop(task_id, None)
             self._cancelled_pending.discard(task_id)
             self._record_task_event(

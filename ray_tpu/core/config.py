@@ -116,6 +116,11 @@ _D("object_spill_dir", "",
    "Spill directory; empty = /tmp/ray_tpu_spill_<node_id>.")
 _D("memory_monitor_refresh_ms", 250, "OOM monitor interval; 0 disables.")
 _D("memory_usage_threshold", 0.95, "Node memory fraction that triggers the OOM killer.")
+_D("borrow_escrow_s", 600.0,
+   "How long a result-embedded ref stays escrow-pinned in its owner "
+   "process, bridging the gap between shipping a result and the "
+   "consumer's register_borrow (reference: reference_count.h borrowing "
+   "protocol, here time-bounded).")
 
 # -- tensor plane --------------------------------------------------------
 _D("tpu_slice_gang_scheduling", True,

@@ -37,6 +37,7 @@ class GcsServer:
         self._snapshot_task: Optional[asyncio.Task] = None
         self._flush_lock = asyncio.Lock()
         self._flush_gen = 0
+        self._flushed_gen = 0  # last generation SUCCESSFULLY written
         # -- tables (reference: gcs_table_storage.h) ----------------------
         self.nodes: Dict[str, Dict[str, Any]] = {}       # node_id hex -> info
         self.actors: Dict[str, Dict[str, Any]] = {}      # actor_id hex -> info
@@ -87,18 +88,32 @@ class GcsServer:
             return
         my_gen = self._flush_gen
         async with self._flush_lock:
-            if self._flush_gen > my_gen:
-                # A snapshot STARTED after this caller's mutation (and
-                # after it queued here) already captured it: coalesce
-                # instead of rewriting full state once per acked KV put.
+            if self._flushed_gen > my_gen:
+                # A snapshot that STARTED after this caller's mutation
+                # (and after it queued here) captured it AND hit disk:
+                # coalesce instead of rewriting full state once per acked
+                # KV put. Comparing against the successfully-WRITTEN
+                # generation matters — coalescing on a failed overlapping
+                # write would ack a mutation that never persisted.
                 return
-            self._flush_gen += 1
+            gen = self._flush_gen = self._flush_gen + 1
             self._dirty = False
+            # Copy on the event loop (two levels: table + record): the
+            # writer thread otherwise pickles live dicts that handlers
+            # keep mutating ("dict changed size during iteration").
+            snap = {t: {k: (dict(v) if isinstance(v, dict) else v)
+                        for k, v in getattr(self, t).items()}
+                    for t in self._PERSISTED_TABLES}
             try:
-                await asyncio.to_thread(self._write_snapshot)
+                await asyncio.to_thread(self._write_snapshot, snap)
+                self._flushed_gen = gen
             except Exception:
                 self._dirty = True  # snapshot loop retries
                 logger.warning("GCS write-through failed", exc_info=True)
+                # Callers ack durability to their clients — a failed
+                # write must surface as a failed mutation, not a silent
+                # success that a crash then forgets.
+                raise
 
     def _load_storage(self) -> None:
         if not self._storage_path:
@@ -132,15 +147,16 @@ class GcsServer:
             # flush_now serializes every writer through _flush_lock —
             # an unsynchronized periodic write could capture older tables
             # yet rename over a newer write-through snapshot.
-            await self.flush_now()
+            try:
+                await self.flush_now()
+            except Exception:
+                pass  # stays dirty; retried next tick
 
-    def _write_snapshot(self) -> None:
+    def _write_snapshot(self, snap: dict) -> None:
         import os
         import pickle
         import threading
 
-        snap = {table: dict(getattr(self, table))
-                for table in self._PERSISTED_TABLES}
         # Unique tmp per writer: stop()'s final flush may overlap an
         # in-flight to_thread write; each renames atomically.
         tmp = (f"{self._storage_path}.tmp.{os.getpid()}"
@@ -159,7 +175,10 @@ class GcsServer:
         if self._storage_path and self._dirty:
             # Final flush: acked mutations survive a clean shutdown
             # (through the same lock as every other writer).
-            await self.flush_now()
+            try:
+                await self.flush_now()
+            except Exception:
+                pass  # already logged; shutdown must proceed
         await self._rpc.stop()
 
     # ------------------------------------------------------------------

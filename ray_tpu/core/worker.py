@@ -53,12 +53,17 @@ def _runtime_is_alive(rt) -> bool:
     check = getattr(rt, "check_alive", None)
     if check is None:
         return True
-    for _ in range(2):
+    for attempt in range(2):
         try:
             if check():
                 return True
         except Exception:
             pass
+        if attempt == 0:
+            # Back-to-back retries land in the same overload window;
+            # give a momentarily-stalled GCS a beat to drain.
+            import time
+            time.sleep(1.0)
     return False
 
 

@@ -285,6 +285,7 @@ class Store {
         std::lock_guard<std::mutex> g(mu_);
         if (pending_spills_.empty()) {
           flushing_ = false;
+          cv_.notify_all();  // shutdown() may be waiting for the flusher
           return;
         }
         PendingSpill& front = pending_spills_.front();
@@ -344,7 +345,14 @@ class Store {
   }
 
   void shutdown() {
-    std::lock_guard<std::mutex> g(mu_);
+    std::unique_lock<std::mutex> lk(mu_);
+    // An executor thread may be mid-fwrite in flush_spills with mu_
+    // released (`writing` item): freeing its buffer here is a UAF, and
+    // clearing the deque makes its later pop_front UB.  Stop new spill
+    // queuing and wait the flusher out — it drains fast because drop()
+    // below will mark every entry gone, so remaining items just free.
+    spill_broken_ = true;  // ensure_space stops queuing new spills
+    cv_.wait(lk, [&] { return !flushing_; });
     for (auto& ps : pending_spills_) free(ps.buf);
     pending_spills_.clear();
     for (auto it = objects_.begin(); it != objects_.end();)

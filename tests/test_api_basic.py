@@ -445,3 +445,40 @@ def test_refs_in_return_values_borrowing(ray_start_regular):
     assert int(vals.sum()) == 499500
     # Still fetchable on a second get (borrow persists until release).
     assert int(ray_tpu.get(out["ref"], timeout=120).sum()) == 499500
+
+
+def test_actor_retains_arg_embedded_ref(ray_start_regular):
+    """An actor that stores an arg-embedded ref in its state must keep
+    the object alive after the caller drops its own reference: the
+    executing worker reports the retained borrow to the owner at task
+    completion (reference: reference_count.h — borrowed refs are
+    reported in the task reply)."""
+    import gc
+    import time
+
+    import numpy as np
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.ref = None
+
+        def hold(self, box):
+            self.ref = box["r"]  # nested => stays an ObjectRef
+            return True
+
+        def fetch(self):
+            return ray_tpu.get(self.ref)
+
+    h = Holder.remote()
+    big = np.arange(200_000)  # > inline threshold => shm-resident
+    r = ray_tpu.put(big)
+    assert ray_tpu.get(h.hold.remote({"r": r}), timeout=120)
+    # Drop the owner's only local reference; without the reported
+    # borrow the driver now frees the object.
+    del r
+    gc.collect()
+    time.sleep(1.0)
+    out = ray_tpu.get(h.fetch.remote(), timeout=120)
+    assert np.array_equal(out, big)
