@@ -50,6 +50,66 @@ class ConnectionLost(RpcError):
     pass
 
 
+class _BatchedWriter:
+    """Coalesces frames queued within one event-loop tick into a single
+    transport write.
+
+    On virtualized hosts a socket send costs 0.1-1 ms of syscall time, so
+    per-frame writes dominate the task hot loop (measured: ~0.8 ms/write
+    on the dev box, 1 write per push_task). Frames appended on the loop
+    between two ticks go out in one send; ordering is append order since
+    every sender runs on the loop thread."""
+
+    __slots__ = ("_writer", "_loop", "_buf", "_scheduled",
+                 "on_write_error")
+
+    # Above this much unflushed transport buffer, senders pause on drain
+    # (backpressure for bulk transfers sharing the connection).
+    DRAIN_THRESHOLD = 4 * 1024 * 1024
+
+    def __init__(self, writer: asyncio.StreamWriter,
+                 loop: asyncio.AbstractEventLoop):
+        self._writer = writer
+        self._loop = loop
+        self._buf: list = []
+        self._scheduled = False
+        self.on_write_error = None
+
+    def send(self, frame: bytes) -> None:
+        self._buf.append(frame)
+        if not self._scheduled:
+            self._scheduled = True
+            self._loop.call_soon(self.flush)
+
+    def flush(self) -> None:
+        self._scheduled = False
+        if not self._buf:
+            return
+        data = self._buf[0] if len(self._buf) == 1 else b"".join(self._buf)
+        self._buf.clear()
+        try:
+            if (self._writer.transport is not None
+                    and self._writer.transport.is_closing()):
+                raise ConnectionResetError("transport closing")
+            self._writer.write(data)
+        except Exception:
+            cb = self.on_write_error
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:
+                    pass
+
+    async def drain_if_needed(self) -> None:
+        transport = self._writer.transport
+        if (transport is not None and not transport.is_closing()
+                and transport.get_write_buffer_size() > self.DRAIN_THRESHOLD):
+            try:
+                await self._writer.drain()
+            except Exception:
+                pass
+
+
 class RpcServer:
     """Serves handler methods named `handle_<method>`; handlers are
     `async def handle_x(self_conn, **args) -> result`."""
@@ -109,9 +169,14 @@ class ServerConnection:
         self._reader = reader
         self._writer = writer
         self._handlers = handlers
-        self._write_lock = asyncio.Lock()
+        self._batch = _BatchedWriter(writer, asyncio.get_running_loop())
         self.metadata: Dict[str, Any] = {}  # handler-attached state
         self.closed = False
+
+        def _mark_closed():
+            self.closed = True
+
+        self._batch.on_write_error = _mark_closed
 
     async def serve(self) -> None:
         try:
@@ -155,14 +220,17 @@ class ServerConnection:
         if self.closed:
             return
         try:
-            async with self._write_lock:
-                self._writer.write(pack(body))
-                await self._writer.drain()
+            self._batch.send(pack(body))
+            await self._batch.drain_if_needed()
         except (ConnectionError, OSError):
             self.closed = True
 
     def close(self) -> None:
         self.closed = True
+        try:
+            self._batch.flush()
+        except Exception:
+            pass
         try:
             self._writer.close()
         except Exception:
@@ -177,9 +245,9 @@ class RpcClient:
         self._host, self._port = host, int(port)
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
+        self._batch: Optional[_BatchedWriter] = None
         self._pending: Dict[int, asyncio.Future] = {}
         self._next_id = 0
-        self._write_lock: Optional[asyncio.Lock] = None
         self._push_handlers: Dict[str, Callable[[Any], Any]] = {}
         self._reader_task: Optional[asyncio.Task] = None
         self.connected = False
@@ -197,7 +265,7 @@ class RpcClient:
             try:
                 self._reader, self._writer = await asyncio.open_connection(
                     self._host, self._port)
-                self._write_lock = asyncio.Lock()
+                self._batch = _BatchedWriter(self._writer, loop)
                 self._reader_task = asyncio.ensure_future(self._read_loop())
                 self.connected = True
                 return
@@ -245,9 +313,8 @@ class RpcClient:
         req_id = self._next_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
-        async with self._write_lock:
-            self._writer.write(pack({"i": req_id, "m": method, "a": args}))
-            await self._writer.drain()
+        self._batch.send(pack({"i": req_id, "m": method, "a": args}))
+        await self._batch.drain_if_needed()
         if timeout is None:
             return await fut
         return await asyncio.wait_for(fut, timeout)
@@ -256,14 +323,15 @@ class RpcClient:
         """Fire-and-forget (no response expected)."""
         if not self.connected:
             raise ConnectionLost(f"not connected to {self.address}")
-        async with self._write_lock:
-            self._writer.write(pack({"i": None, "m": method, "a": args}))
-            await self._writer.drain()
+        self._batch.send(pack({"i": None, "m": method, "a": args}))
+        await self._batch.drain_if_needed()
 
     async def close(self) -> None:
         self.connected = False
         if self._reader_task is not None:
             self._reader_task.cancel()
+        if self._batch is not None:
+            self._batch.flush()
         if self._writer is not None:
             try:
                 self._writer.close()

@@ -53,12 +53,13 @@ class SerializedObject:
 
     def write_into(self, buf) -> None:
         """Append the wire format into `buf` (bytearray or shm memoryview wrapper)."""
+        for chunk in self.chunks():
+            buf += chunk
+
+    def chunks(self) -> List:
+        """The wire format as a chunk list (for scatter-gather writes)."""
         meta = self._metadata()
-        buf += _HEADER.pack(len(meta))
-        buf += meta
-        buf += self.payload
-        for b in self.buffers:
-            buf += b
+        return [_HEADER.pack(len(meta)) + meta, self.payload, *self.buffers]
 
 
 def serialize(value: Any, *,

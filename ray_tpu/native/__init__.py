@@ -52,7 +52,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rts_open.argtypes = [s, s, u64]
     lib.rts_open.restype = p
     lib.rts_close.argtypes = [p]
-    lib.rts_create.argtypes = [p, s, u64]
+    lib.rts_create.argtypes = [p, s, u64, ctypes.c_char_p, ctypes.c_int]
     lib.rts_create.restype = ctypes.c_int
     lib.rts_seal.argtypes = [p, s]
     lib.rts_seal.restype = ctypes.c_int

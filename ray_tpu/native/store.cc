@@ -39,6 +39,7 @@
 #include <list>
 #include <mutex>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <unordered_set>
 #include <vector>
@@ -67,13 +68,26 @@ enum class St : uint8_t { RESIDENT, SPILLING, SPILLED, RESTORING };
 
 struct Entry {
   std::string shm_name;
-  uint64_t size = 0;
+  uint64_t size = 0;             // logical object size
+  uint64_t seg_size = 0;         // physical segment size (>= size when the
+                                 // segment came from the prefault pool)
   bool sealed = false;
   St state = St::RESIDENT;
   double created_at = 0;
   uint8_t* base = nullptr;       // store-side mapping (null when spilled)
   std::unordered_set<std::string> pins;
   std::list<std::string>::iterator lru_it;  // valid while RESIDENT+sealed
+};
+
+// A pre-created, pre-allocated (fallocate), never-used segment awaiting
+// assignment.  Pool segments are VIRGIN by construction: a segment is
+// never returned to the pool after an object lived in it, so the store's
+// core guarantee — a reader's zero-copy mapping stays valid (and frozen)
+// after eviction — is untouched by pooling.  Not mapped while pooled;
+// the store maps on assignment (mmap is cheap, page allocation is not).
+struct PooledSeg {
+  std::string name;
+  uint64_t size;
 };
 
 struct PendingSpill {
@@ -94,29 +108,58 @@ double now_secs() {
 
 class Store {
  public:
+  // Fresh tmpfs pages cost ~4 us each to allocate at first touch (~10 ms
+  // for a 10 MB object if the writing worker pays it through mmap write
+  // faults).  Two-part fix: (1) workers write via pwritev, not a fresh
+  // shared mapping — kernel-side copy, no per-page user write faults;
+  // (2) this warm thread pre-creates virgin segments in pow-2 size
+  // classes with fallocate (page allocation without the zeroing write),
+  // so create() hands out a segment whose pages already exist.  (The
+  // reference's dlmalloc arena gets warm pages by REUSE —
+  // plasma/dlmalloc.cc; reuse would break this store's
+  // frozen-mapping-after-eviction guarantee, pre-allocation does not.)
+  static constexpr uint64_t kPoolMinClass = 1ull << 20;   // 1 MiB
+  static constexpr uint64_t kPoolMaxClass = 1ull << 26;   // 64 MiB
+  static constexpr int kPoolTargetPerClass = 2;
+
   Store(std::string prefix, std::string spill_dir, uint64_t capacity)
       : prefix_(std::move(prefix)), spill_dir_(std::move(spill_dir)),
         capacity_(capacity) {
     if (!spill_dir_.empty() && mkdir(spill_dir_.c_str(), 0700) != 0 &&
         errno != EEXIST)
       spill_broken_ = true;  // fall back to hard eviction
+    pool_budget_ = capacity_ / 4;
+    if (pool_budget_ > (256ull << 20)) pool_budget_ = 256ull << 20;
+    warm_thread_ = std::thread([this] { warm_loop(); });
   }
 
-  ~Store() { shutdown(); }
+  ~Store() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      stopping_ = true;
+      pool_cv_.notify_all();
+    }
+    if (warm_thread_.joinable()) warm_thread_.join();
+    shutdown();
+  }
 
-  int create(const std::string& oid, uint64_t size) {
+  int create(const std::string& oid, uint64_t size, std::string* name_out) {
     std::unique_lock<std::mutex> lk(mu_);
     auto it = objects_.find(oid);
-    if (it != objects_.end())
+    if (it != objects_.end()) {
+      if (name_out) *name_out = it->second.shm_name;
       return it->second.sealed ? kSealedExists : kExistsUnsealed;
+    }
     if (size > capacity_) return kTooBig;
-    if (!ensure_space(size)) return kFull;
+    uint64_t cls = pool_class(size);
+    if (!ensure_space(cls ? cls : size)) return kFull;
     Entry e;
     e.shm_name = shm_name_for(oid);
     e.size = size;
     e.created_at = now_secs();
-    if (!map_segment(e, /*create=*/true)) return kIoError;
-    used_ += size;
+    if (!alloc_segment(e)) return kIoError;
+    used_ += seg_bytes(e);
+    if (name_out) *name_out = e.shm_name;
     objects_.emplace(oid, std::move(e));
     return kOk;
   }
@@ -352,12 +395,15 @@ class Store {
     // queuing and wait the flusher out — it drains fast because drop()
     // below will mark every entry gone, so remaining items just free.
     spill_broken_ = true;  // ensure_space stops queuing new spills
+    stopping_ = true;      // warm thread discards in-flight segments
+    pool_cv_.notify_all();
     cv_.wait(lk, [&] { return !flushing_; });
     for (auto& ps : pending_spills_) free(ps.buf);
     pending_spills_.clear();
     for (auto it = objects_.begin(); it != objects_.end();)
       drop(it++, /*unlink_shm=*/true, /*remove_spill=*/true);
     lru_.clear();
+    drain_pool();
   }
 
  private:
@@ -367,7 +413,8 @@ class Store {
     // (a pulled replica) never collide on segment names.  The oid's
     // trailing 8 hex chars are the put/return index (ids.py ObjectID) —
     // sibling objects of one task differ ONLY there, so the tail must
-    // survive truncation.  Mirrored by NativeObjectStore._shm_name.
+    // survive truncation.  (Names are reported back through create/info;
+    // pooled segments carry pool names instead of oid-derived ones.)
     size_t room = 30 - prefix_.size();
     if (oid.size() <= room) return prefix_ + oid;
     return prefix_ + oid.substr(0, room - 8) + oid.substr(oid.size() - 8);
@@ -380,6 +427,104 @@ class Store {
     return spill_dir_ + "/" + prefix_ + oid;
   }
 
+  static uint64_t pool_class(uint64_t size) {
+    if (size < kPoolMinClass || size > kPoolMaxClass) return 0;
+    uint64_t c = kPoolMinClass;
+    while (c < size) c <<= 1;
+    return c;
+  }
+
+  uint64_t seg_bytes(const Entry& e) const {
+    return e.seg_size ? e.seg_size : (e.size ? e.size : 1);
+  }
+
+  // Lock held.  Give `e` (size set) a segment: a pre-allocated virgin
+  // one from the pool when available, else a fresh exact-size mapping.
+  bool alloc_segment(Entry& e) {
+    uint64_t cls = pool_class(e.size);
+    if (cls) {
+      want_[cls] = kPoolTargetPerClass;
+      auto pit = pool_.begin();
+      while (pit != pool_.end() && pit->size != cls) ++pit;
+      pool_cv_.notify_one();  // hit: refill / miss: note the demand
+      if (pit != pool_.end()) {
+        std::string name = pit->name;
+        uint64_t seg = pit->size;
+        pool_bytes_ -= seg;
+        pool_.erase(pit);
+        std::string keep_name = e.shm_name;
+        e.shm_name = name;
+        e.seg_size = seg;
+        if (map_segment(e, /*create=*/false)) return true;
+        // Pooled segment vanished (external tmpfs cleanup?): fall back
+        // to a fresh mapping under the original name.
+        shm_unlink(("/" + name).c_str());
+        e.shm_name = keep_name;
+        e.seg_size = 0;
+      }
+    }
+    if (!map_segment(e, /*create=*/true)) return false;
+    e.seg_size = e.size ? e.size : 1;
+    return true;
+  }
+
+  // Background: keep `want_`ed size classes stocked with pre-faulted
+  // virgin segments.  Segment creation and the memset run WITHOUT mu_.
+  void warm_loop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    uint64_t counter = 0;
+    while (!stopping_) {
+      uint64_t need = 0;
+      for (auto& kv : want_) {
+        int have = 0;
+        for (auto& p : pool_)
+          if (p.size == kv.first) ++have;
+        if (have < kv.second && pool_bytes_ + kv.first <= pool_budget_) {
+          need = kv.first;
+          break;
+        }
+      }
+      if (need == 0) {
+        pool_cv_.wait(lk);
+        continue;
+      }
+      std::string name =
+          prefix_ + "w" + std::to_string(getpid() % 100000) + "x" +
+          std::to_string(counter++);
+      lk.unlock();
+      int fd = shm_open(("/" + name).c_str(), O_CREAT | O_EXCL | O_RDWR,
+                        0600);
+      // fallocate allocates the tmpfs pages without writing 16 MB of
+      // zeros: ~10x cheaper than memset, so refills barely compete with
+      // foreground work even on small hosts.
+      bool ok = fd >= 0 && ftruncate(fd, off_t(need)) == 0 &&
+                fallocate(fd, 0, 0, off_t(need)) == 0;
+      if (fd >= 0) {
+        close(fd);
+        if (!ok) shm_unlink(("/" + name).c_str());
+      }
+      lk.lock();
+      if (!ok) {
+        want_.erase(need);  // tmpfs full / clash: stop chasing this class
+        continue;
+      }
+      if (stopping_ || pool_bytes_ + need > pool_budget_) {
+        shm_unlink(("/" + name).c_str());
+        continue;
+      }
+      pool_.push_back({name, need});
+      pool_bytes_ += need;
+    }
+  }
+
+  // Lock held.  Unlink every pooled segment (shutdown path).
+  void drain_pool() {
+    for (auto& p : pool_) shm_unlink(("/" + p.name).c_str());
+    pool_.clear();
+    pool_bytes_ = 0;
+    want_.clear();
+  }
+
   bool map_segment(Entry& e, bool create) {
     int flags = create ? (O_CREAT | O_EXCL | O_RDWR) : O_RDWR;
     int fd = shm_open(("/" + e.shm_name).c_str(), flags, 0600);
@@ -389,7 +534,9 @@ class Store {
       fd = shm_open(("/" + e.shm_name).c_str(), flags, 0600);
     }
     if (fd < 0) return false;
-    uint64_t len = e.size ? e.size : 1;
+    // Pooled segments are larger than the object: map the whole segment
+    // so unmap_segment's munmap length matches.
+    uint64_t len = e.seg_size ? e.seg_size : (e.size ? e.size : 1);
     if (create && ftruncate(fd, off_t(len)) != 0) {
       close(fd);
       shm_unlink(("/" + e.shm_name).c_str());
@@ -407,10 +554,17 @@ class Store {
 
   void unmap_segment(Entry& e, bool unlink_name) {
     if (e.base) {
-      munmap(e.base, e.size ? e.size : 1);
+      munmap(e.base, seg_bytes(e));
       e.base = nullptr;
     }
+    e.seg_size = 0;  // a later restore allocates a fresh segment
     if (unlink_name) shm_unlink(("/" + e.shm_name).c_str());
+  }
+
+  // What an allocation for a `size`-byte object physically costs.
+  uint64_t alloc_need(uint64_t size) const {
+    uint64_t c = pool_class(size);
+    return c ? c : (size ? size : 1);
   }
 
   // Look up a sealed entry and make sure it is resident, restoring from
@@ -453,7 +607,7 @@ class Store {
             }
           };
           if (buf == nullptr) return nullptr;  // shouldn't happen
-          if (!ensure_space(e.size) || !map_segment(e, /*create=*/true)) {
+          if (!ensure_space(alloc_need(e.size)) || !alloc_segment(e)) {
             // Bytes are unrecoverable: drop the entry so contains()
             // stops promising an object we cannot serve (owners
             // reconstruct via lineage).  A writing item's buffer is
@@ -470,7 +624,7 @@ class Store {
             erase_item();
             free(buf);
           }
-          used_ += e.size;
+          used_ += seg_bytes(e);
           e.state = St::RESIDENT;
           lru_.push_back(oid);
           e.lru_it = std::prev(lru_.end());
@@ -507,8 +661,8 @@ class Store {
             drop(it2, /*unlink_shm=*/false, /*remove_spill=*/true);
             return nullptr;
           }
-          if (!ok || !ensure_space(size) ||
-              !map_segment(e2, /*create=*/true)) {
+          if (!ok || !ensure_space(alloc_need(size)) ||
+              !alloc_segment(e2)) {
             // Transient (memory pressure / segment clash): the file is
             // intact, keep it SPILLED and let a later read retry.
             e2.state = St::SPILLED;
@@ -519,7 +673,7 @@ class Store {
           memcpy(e2.base, buf, size);
           free(buf);
           remove(path.c_str());
-          used_ += size;
+          used_ += seg_bytes(e2);
           e2.state = St::RESIDENT;
           lru_.push_back(oid);
           e2.lru_it = std::prev(lru_.end());
@@ -549,8 +703,8 @@ class Store {
     if (buf == nullptr) return;
     memcpy(buf, e.base, e.size);
     pending_spills_.push_back({oid, buf, e.size});
+    used_ -= seg_bytes(e);
     unmap_segment(e, /*unlink_name=*/true);
-    used_ -= e.size;
     lru_.erase(e.lru_it);
     e.state = St::SPILLING;
   }
@@ -581,7 +735,7 @@ class Store {
     Entry& e = it->second;
     switch (e.state) {
       case St::RESIDENT:
-        used_ -= e.size;
+        used_ -= seg_bytes(e);
         unmap_segment(e, unlink_shm);
         if (e.sealed) lru_.erase(e.lru_it);
         break;
@@ -608,6 +762,14 @@ class Store {
   std::unordered_map<std::string, Entry> objects_;
   std::list<std::string> lru_;  // resident sealed objects, oldest first
   std::deque<PendingSpill> pending_spills_;
+  // -- prefault pool (see class comment) ------------------------------
+  std::condition_variable pool_cv_;
+  std::thread warm_thread_;
+  bool stopping_ = false;
+  std::vector<PooledSeg> pool_;
+  uint64_t pool_bytes_ = 0;
+  uint64_t pool_budget_ = 0;
+  std::unordered_map<uint64_t, int> want_;  // size class -> target count
 };
 
 }  // namespace
@@ -621,10 +783,18 @@ void* rts_open(const char* prefix, const char* spill_dir,
 
 void rts_close(void* h) { delete static_cast<Store*>(h); }
 
-int rts_create(void* h, const char* oid, uint64_t size) {
+// Writes the assigned segment name (pooled segments have pool names, not
+// oid-derived ones) into name_out.  Pass name_cap 0 to skip.
+int rts_create(void* h, const char* oid, uint64_t size, char* name_out,
+               int name_cap) {
   Store* s = static_cast<Store*>(h);
-  int rc = s->create(oid, size);
+  std::string name;
+  int rc = s->create(oid, size, &name);
   s->flush_spills();  // write queued victims to disk, lock-free
+  if (name_out && name_cap > 0) {
+    if (int(name.size()) + 1 > name_cap) return kIoError;
+    memcpy(name_out, name.c_str(), name.size() + 1);
+  }
   return rc;
 }
 
