@@ -106,6 +106,13 @@ class _TaskCancelledBeforePush(Exception):
     """Internal: cancel() landed while the task was queued for a lease."""
 
 
+class _WorkerOOMKilled(RpcError):
+    """Internal: the raylet's memory monitor killed the worker mid-task.
+    Retryable like any worker death, but surfaces as a typed
+    OutOfMemoryError when retries run out (reference: the OOM task
+    failure reason from worker_killing_policy.h)."""
+
+
 class _LeasePool:
     """Per-scheduling-key worker leases (reference: direct_task_transport
     SchedulingKey entries + pipelined lease requests,
@@ -128,8 +135,10 @@ class ClusterRuntime:
     def __init__(self, *, gcs_address: str, raylet_address: str,
                  mode: str = "driver", worker_id: Optional[str] = None,
                  node_id: Optional[str] = None,
-                 namespace: Optional[str] = None, node=None):
+                 namespace: Optional[str] = None, node=None,
+                 log_to_driver: bool = True):
         self.mode = mode
+        self._log_to_driver = log_to_driver and mode == "driver"
         self.namespace = namespace or "default"
         self.gcs_address = gcs_address
         self.raylet_address = raylet_address
@@ -148,6 +157,7 @@ class ClusterRuntime:
         self._loop.run(self._async_start())
 
         self._shm = WorkerStoreClient()
+        self._shm_by_oid: Dict[str, str] = {}  # fetched oid -> segment
         self._owned: Dict[str, _Owned] = {}
         self._owned_lock = threading.Lock()
         # Refs this process BORROWS (owner elsewhere): oid -> [owner
@@ -226,7 +236,32 @@ class ClusterRuntime:
             await self._gcs.subscribe("node", self._on_node_event)
         except Exception:
             logger.warning("node-event subscription failed", exc_info=True)
+        if self._log_to_driver:
+            # Remote prints/tracebacks stream to this driver's stderr
+            # (reference: _private/worker.py:812 print_logs over GCS
+            # pubsub, fed by log_monitor.py:103 tails on each node).
+            try:
+                await self._gcs.subscribe("worker_logs",
+                                          self._on_worker_logs)
+            except Exception:
+                logger.warning("worker-log subscription failed",
+                               exc_info=True)
         self._start_metrics_push()
+
+    def _on_worker_logs(self, data: dict) -> None:
+        import sys
+
+        if not isinstance(data, dict):
+            return
+        my_job = self.job_id.hex()
+        for entry in data.get("entries", ()):
+            job = entry.get("job_id")
+            if job and job != my_job:
+                continue  # another driver's worker
+            tag = entry.get("actor_id") or entry.get("worker_id", "?")[:8]
+            prefix = f"({tag}, pid={entry.get('pid', '?')})"
+            for line in entry.get("lines", ()):
+                print(f"{prefix} {line}", file=sys.stderr)
 
     async def _on_node_event(self, data: dict) -> None:
         if not isinstance(data, dict) or data.get("alive", True):
@@ -330,6 +365,7 @@ class ClusterRuntime:
                          resources: Optional[dict] = None,
                          namespace: Optional[str] = None,
                          object_store_memory: Optional[int] = None,
+                         log_to_driver: bool = True,
                          **_: Any) -> "ClusterRuntime":
         from ray_tpu.core.node import NodeSupervisor
 
@@ -340,7 +376,8 @@ class ClusterRuntime:
             return cls(gcs_address=node.gcs_address,
                        raylet_address=node.raylet_address,
                        namespace=namespace, node=node,
-                       node_id=node.node_id)
+                       node_id=node.node_id,
+                       log_to_driver=log_to_driver)
         if address.startswith("ray://"):
             address = address[len("ray://"):]
         # Connect to an existing cluster: find this machine's raylet (or the
@@ -358,7 +395,8 @@ class ClusterRuntime:
             raise ConnectionError(f"no alive nodes at GCS {address}")
         head = next((n for n in alive if n.get("is_head")), alive[0])
         return cls(gcs_address=address, raylet_address=head["address"],
-                   namespace=namespace, node_id=head["node_id"])
+                   namespace=namespace, node_id=head["node_id"],
+                   log_to_driver=log_to_driver)
 
     def check_alive(self) -> bool:
         """Cheap liveness probe: is our GCS still answering?
@@ -438,6 +476,7 @@ class ClusterRuntime:
                 return
             del self._owned[oid]
             nodes = list(entry.nodes)
+        self._release_shm_mapping(oid)
         rec = self._lineage.pop(oid, None)
         if rec is not None:
             rec["live"] -= 1
@@ -524,6 +563,17 @@ class ClusterRuntime:
 
             self._loop.spawn(_register())
 
+    def _release_shm_mapping(self, oid: str) -> None:
+        """Unmap the local view of a fetched object once the last local
+        reference drops; deferred (object_store._deferred) while
+        deserialized zero-copy views still alias the mapping."""
+        name = self._shm_by_oid.pop(oid, None)
+        if name is not None:
+            try:
+                self._shm.release(name)
+            except Exception:
+                pass
+
     def _release_borrow(self, oid: str) -> None:
         with self._borrowed_lock:
             rec = self._borrowed.get(oid)
@@ -534,11 +584,12 @@ class ClusterRuntime:
                 return
             del self._borrowed[oid]
             owner = rec[0]
-            if not rec[2]:
-                # The owner never ACKed our register_borrow: sending a
-                # release would decrement a count that was never
-                # incremented (premature free at the owner).
-                return
+        self._release_shm_mapping(oid)
+        if not rec[2]:
+            # The owner never ACKed our register_borrow: sending a
+            # release would decrement a count that was never
+            # incremented (premature free at the owner).
+            return
 
         async def _release():
             try:
@@ -590,12 +641,11 @@ class ClusterRuntime:
             return
         shm_name = self._loop.run(
             self._raylet.call("create_object", oid=oid, size=size))
-
-        def write(buf):
-            so.write_into(_WriteIntoShm(buf))
-
-        self._shm.write(shm_name, write)
-        self._loop.run(self._raylet.call("seal_object", oid=oid))
+        self._shm.write_chunks(shm_name, so.chunks())
+        # Fire-and-forget: frames are processed in order on this
+        # connection, and remote pulls poll until the seal lands
+        # (handle_pull_object), so nothing needs the round trip.
+        self._loop.run(self._raylet.notify("seal_object", oid=oid))
         if self.raylet_address not in entry.nodes:
             entry.nodes.append(self.raylet_address)
         entry.is_stored = True
@@ -604,25 +654,35 @@ class ClusterRuntime:
     def _deserialize_payload(self, data) -> Any:
         return serialization.deserialize(data)
 
-    def _read_local_shm(self, info: dict) -> Any:
+    def _read_local_shm(self, info: dict, oid: Optional[str] = None) -> Any:
         view = self._shm.read(info["shm_name"], info["size"])
+        if oid is not None:
+            # Remember the mapping so the segment can be unmapped when
+            # the last local reference to this object drops (deferred if
+            # zero-copy views still alias it).
+            self._shm_by_oid[oid] = info["shm_name"]
         return self._deserialize_payload(view)
 
-    def _fetch(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
-        """Blocking fetch of one object's value."""
+    async def _resolve_async(self, ref: ObjectRef,
+                             timeout: Optional[float]):
+        """The IO half of a fetch (local future / raylet pull); returns
+        ("inline", bytes) or ("shm", info) without deserializing, so a
+        multi-ref get can gather many of these concurrently on the RPC
+        loop (reference: batched plasma Get, core_worker.cc:1358-1430)
+        and deserialize on the caller's thread."""
         oid = ref.hex()
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
-        entry = None
         with self._owned_lock:
             entry = self._owned.get(oid)
         if entry is not None:
             try:
-                kind, payload = entry.fut.result(timeout=timeout)
-            except concurrent.futures.TimeoutError:
+                kind, payload = await asyncio.wait_for(
+                    asyncio.wrap_future(entry.fut), timeout)
+            except (asyncio.TimeoutError, TimeoutError):
                 raise GetTimeoutError(f"timed out waiting for {ref}")
             if kind == "inline":
-                return self._deserialize_payload(payload)
+                return ("inline", payload, oid)
             # stored on some node; pull through the local raylet
             owner_addr = self.address
         else:
@@ -632,10 +692,10 @@ class ClusterRuntime:
         remaining = (None if deadline is None
                      else max(0.0, deadline - time.monotonic()))
         try:
-            res = self._loop.run(self._raylet.call(
+            res = await asyncio.wait_for(self._raylet.call(
                 "pull_object", oid=oid, owner_address=owner_addr,
-                pull_timeout=remaining, timeout=None), timeout=remaining)
-        except concurrent.futures.TimeoutError:
+                pull_timeout=remaining, timeout=None), remaining)
+        except (asyncio.TimeoutError, TimeoutError):
             raise GetTimeoutError(f"timed out fetching {ref}")
         if res is None:
             raise ObjectLostError(oid)
@@ -645,8 +705,19 @@ class ClusterRuntime:
                                       f"{res['error']}")
             raise ObjectLostError(oid)
         if "inline" in res and res["inline"] is not None:
-            return self._deserialize_payload(res["inline"])
-        return self._read_local_shm(res)
+            return ("inline", res["inline"], oid)
+        return ("shm", res, oid)
+
+    def _materialize(self, resolved) -> Any:
+        kind, payload, oid = resolved
+        if kind == "inline":
+            return self._deserialize_payload(payload)
+        return self._read_local_shm(payload, oid)
+
+    def _fetch(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
+        """Blocking fetch of one object's value."""
+        return self._materialize(
+            self._loop.run(self._resolve_async(ref, timeout), timeout=None))
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, (ObjectRef, ObjectRefGenerator))
@@ -655,9 +726,6 @@ class ClusterRuntime:
                 "get() expects an ObjectRef or a list of ObjectRefs, got "
                 f"{type(refs).__name__}")
         ref_list = [refs] if single else list(refs)
-        deadline = (None if timeout is None
-                    else time.monotonic() + timeout)
-        out = []
         for ref in ref_list:
             if isinstance(ref, ObjectRefGenerator):
                 raise TypeError(
@@ -665,10 +733,18 @@ class ClusterRuntime:
             if not isinstance(ref, ObjectRef):
                 raise TypeError(
                     f"get() expects ObjectRef(s), got {type(ref).__name__}")
-            remaining = (None if deadline is None
-                         else max(0.0, deadline - time.monotonic()))
-            out.append(self._fetch(ref, remaining))
-        return out[0] if single else out
+        if single or len(ref_list) == 1:
+            value = self._fetch(ref_list[0], timeout)
+            return value if single else [value]
+
+        async def _resolve_all():
+            # Concurrent: N remote objects cost one round-trip latency,
+            # not N (the round-3 sequential-get finding).
+            return await asyncio.gather(
+                *(self._resolve_async(r, timeout) for r in ref_list))
+
+        resolved = self._loop.run(_resolve_all(), timeout=None)
+        return [self._materialize(r) for r in resolved]
 
     async def _ask_owner_locations_batch(self, owner_addr: str,
                                          oids: List[str]):
@@ -876,9 +952,13 @@ class ClusterRuntime:
                         return
                     attempt += 1
                     if attempt > max(retries, 0):
+                        oom = isinstance(e, _WorkerOOMKilled)
                         self._fail_task(
                             spec, refs,
-                            f"worker died ({e}); retries exhausted")
+                            ("killed by the memory monitor (node OOM); "
+                             "retries exhausted" if oom else
+                             f"worker died ({e}); retries exhausted"),
+                            oom=oom)
                         return
                     logger.info("retrying task %s (attempt %d): %s",
                                 spec["name"], attempt, e)
@@ -909,11 +989,25 @@ class ClusterRuntime:
         if gen is not None:
             gen._finish(TaskCancelledError(spec["task_id"]))
 
+    async def _worker_was_oom_killed(self, worker: dict) -> bool:
+        # Short dial: if the worker died because its whole NODE died,
+        # this probe must cost ~2s, not a full connect window per retry.
+        try:
+            client = await self._raylet_client(worker["raylet_address"],
+                                               connect_timeout=2.0)
+            cause = await client.call("worker_death_cause",
+                                      worker_id=worker["worker_id"],
+                                      timeout=5.0)
+        except Exception:
+            return False
+        return cause == "oom"
+
     def _fail_task(self, spec: dict, refs: List[ObjectRef],
-                   message: str) -> None:
-        from ray_tpu.exceptions import WorkerCrashedError
+                   message: str, oom: bool = False) -> None:
+        from ray_tpu.exceptions import OutOfMemoryError, WorkerCrashedError
+        exc_cls = OutOfMemoryError if oom else WorkerCrashedError
         err = serialization.serialize_error(
-            WorkerCrashedError(f"task {spec['name']}: {message}"))
+            exc_cls(f"task {spec['name']}: {message}"))
         blob = err.to_bytes()
         for r in refs:
             entry = self._owned_entry(r.hex())
@@ -936,25 +1030,43 @@ class ClusterRuntime:
         worker = await self._acquire_worker(key, spec["resources"], pg=pg)
         if spec["task_id"] in self._cancel_requested:
             # Cancelled while queued for a lease: never push.
-            await self._release_worker(key, worker)
+            self._offer_worker(key, worker)
             raise _TaskCancelledBeforePush()
         if worker.get("chip_ids"):
             spec = dict(spec, visible_chips=worker["chip_ids"])
         self._inflight_task_workers[spec["task_id"]] = (
             worker["worker_address"], False)
+        worker["pipeline"] = worker.get("pipeline", 0) + 1
         try:
             client = await self._worker_client(worker["worker_address"])
+            # Pipelining: once the push is on the wire the lease goes
+            # back into circulation (bounded by worker_pipeline_depth),
+            # so the worker's execution queue stays fed across the
+            # push/reply round trip instead of idling one RTT per task.
+            self._offer_worker(key, worker)
             reply = await client.call("push_task", spec=spec, timeout=None)
-        except Exception:
-            await self._return_worker(worker, dead=True)
+        except BaseException as push_err:
+            # BaseException on purpose: a CancelledError that skipped the
+            # decrement would wedge the lease at pipeline>0 forever — the
+            # linger loop then never returns it and the raylet's CPUs
+            # leak (observed as a cluster-wide scheduling stall).
+            worker["pipeline"] -= 1
+            if isinstance(push_err, Exception):
+                worker["dead"] = True
+                if not worker.get("returned"):
+                    worker["returned"] = True
+                    await self._return_worker(worker, dead=True)
+                if await self._worker_was_oom_killed(worker):
+                    raise _WorkerOOMKilled(str(push_err)) from push_err
             raise
         finally:
             self._inflight_task_workers.pop(spec["task_id"], None)
         # Only a completed task clears its cancel flag — on a push
         # failure _submit_async must still see it to suppress the retry.
         self._cancel_requested.discard(spec["task_id"])
+        worker["pipeline"] -= 1
         self._record_task_reply(spec, reply)
-        await self._release_worker(key, worker)
+        self._offer_worker(key, worker)
 
     def _record_task_reply(self, spec: dict, reply: dict) -> None:
         task_id = spec["task_id"]
@@ -999,8 +1111,12 @@ class ClusterRuntime:
         is what makes a burst of small same-shape tasks run at worker
         speed instead of lease-RPC speed."""
         pool = self._lease_pools.setdefault(key, _LeasePool())
-        if pool.idle:
-            return pool.idle.pop()
+        while pool.idle:
+            worker = pool.idle.pop()
+            if worker.get("dead"):
+                continue  # died while idling (e.g. OOM-killed mid-pipeline)
+            worker["avail"] = False
+            return worker
         fut = asyncio.get_running_loop().create_future()
         pool.waiters.append(fut)
         self._pump_leases(pool, resources, pg)
@@ -1041,12 +1157,27 @@ class ClusterRuntime:
         pool.inflight_leases -= 1
         self._hand_worker(pool, worker)
 
+    def _offer_worker(self, key: str, worker: dict) -> None:
+        """Put a leased worker (back) into circulation if it is alive,
+        not already circulating, and has pipeline window left."""
+        if worker.get("dead") or worker.get("avail"):
+            return
+        if (worker.get("pipeline", 0)
+                >= ray_config().worker_pipeline_depth):
+            return
+        pool = self._lease_pools.setdefault(key, _LeasePool())
+        self._hand_worker(pool, worker)
+
     def _hand_worker(self, pool: _LeasePool, worker: dict) -> None:
+        if worker.get("dead"):
+            return
         while pool.waiters:
             fut = pool.waiters.pop(0)
             if not fut.done():
+                worker["avail"] = False  # exclusively promised
                 fut.set_result(worker)
                 return
+        worker["avail"] = True
         pool.idle.append(worker)
         asyncio.ensure_future(self._linger_then_return(pool, worker))
 
@@ -1055,8 +1186,27 @@ class ClusterRuntime:
         """An idle lease is kept briefly for reuse, then returned so the
         raylet can reschedule its resources."""
         await asyncio.sleep(0.05)
-        if worker in pool.idle:
-            pool.idle.remove(worker)
+        lingered = 0.0
+        while worker in pool.idle and worker.get("pipeline", 0) > 0:
+            # Pipelined pushes still executing: the lease cannot be
+            # returned yet. Bounded wait — a pipeline counter that never
+            # drains (accounting bug, wedged push) must not pin the
+            # raylet's resources forever; force-return past the cap.
+            if lingered > 10.0:
+                logger.warning(
+                    "lease %s idle with pipeline=%s for %.0fs; "
+                    "force-returning it",
+                    worker.get("lease_id"), worker.get("pipeline"),
+                    lingered)
+                break
+            await asyncio.sleep(0.25)
+            lingered += 0.25
+        if worker not in pool.idle:
+            return
+        pool.idle.remove(worker)
+        worker["avail"] = False
+        if not worker.get("returned"):
+            worker["returned"] = True
             await self._return_worker(worker)
 
     async def _request_lease(self, resources: Dict[str, float],
@@ -1095,6 +1245,7 @@ class ClusterRuntime:
                     is_actor=is_actor, spillback_count=spillbacks,
                     bundle=list(bundle) if bundle else None,
                     request_id=request_id,
+                    job_id=self.job_id.hex(),
                     timeout=ray_config().worker_lease_timeout_ms / 1000.0)
             except (TimeoutError, asyncio.TimeoutError):
                 # Tell the raylet we gave up: drop the queued request, or
@@ -1115,12 +1266,6 @@ class ClusterRuntime:
                 spillbacks += 1
                 continue
             raise RpcError(f"lease failed: {reply}")
-
-    async def _release_worker(self, key: str, worker: dict) -> None:
-        pool = self._lease_pools.setdefault(key, _LeasePool())
-        # Hand straight to a queued waiter if any; else idle-cache with a
-        # linger before returning to the raylet.
-        self._hand_worker(pool, worker)
 
     async def _return_worker(self, worker: dict, dead: bool = False) -> None:
         try:
@@ -2050,9 +2195,9 @@ class ClusterRuntime:
             return {"oid": oid, "inline": so.to_bytes()}
         shm_name = self._loop.run(
             self._raylet.call("create_object", oid=oid, size=size))
-        self._shm.write(shm_name, lambda buf: so.write_into(
-            _WriteIntoShm(buf)))
-        self._loop.run(self._raylet.call("seal_object", oid=oid))
+        self._shm.write_chunks(shm_name, so.chunks())
+        # See _store_serialized: seal needs no round trip.
+        self._loop.run(self._raylet.notify("seal_object", oid=oid))
         return {"oid": oid, "node": self.raylet_address}
 
     def _execute_task(self, spec: dict) -> dict:

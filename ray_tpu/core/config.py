@@ -102,6 +102,11 @@ _D("task_events_flush_interval_ms", 1000,
    "Task event buffer flush interval (reference: task_event_buffer.h).")
 _D("max_pending_lease_requests_per_scheduling_category", 10,
    "Pipelined lease requests per scheduling key (reference name identical).")
+_D("worker_pipeline_depth", 8,
+   "Tasks pushed to one leased worker before its first reply returns. "
+   "Keeps the worker's (single-threaded) execution queue fed across the "
+   "push/reply round trip instead of idling it for one RTT per task "
+   "(reference: lease reuse in direct_task_transport.cc OnWorkerIdle).")
 _D("scheduler_spread_threshold", 0.5,
    "Hybrid policy utilization threshold below which tasks pack on the local "
    "node (reference: hybrid_scheduling_policy.h).")

@@ -41,6 +41,10 @@ class _Worker:
         self.held: Dict[str, float] = {}  # resources held by active lease
         self.bundle_key: Optional[str] = None  # PG bundle the lease drew from
         self.chip_ids: List[int] = []  # TPU chips granted to this lease
+        self.granted_at = 0.0  # lease grant time (OOM policy: newest dies)
+        self.log_path: Optional[str] = None
+        self.log_offset = 0  # how far the log monitor has shipped
+        self.lease_job_id: Optional[str] = None  # job of the active lease
 
 
 class _Bundle:
@@ -67,13 +71,15 @@ class _PendingLease:
                  scheduling_key: str,
                  bundle_key: Optional[str] = None,
                  request_id: Optional[str] = None,
-                 spillback_count: int = 0):
+                 spillback_count: int = 0,
+                 job_id: Optional[str] = None):
         self.demand = demand
         self.is_actor = is_actor
         self.scheduling_key = scheduling_key
         self.bundle_key = bundle_key
         self.request_id = request_id
         self.spillback_count = spillback_count
+        self.job_id = job_id
         self.conn: Optional[ServerConnection] = None
         self.created_at = time.monotonic()
         self.future: asyncio.Future = asyncio.get_event_loop().create_future()
@@ -122,6 +128,11 @@ class Raylet:
         # grants, so disconnect reclaims them.
         self._lease_conns: Dict[str, tuple] = {}
         self._stopping = False
+        # worker_id -> why the raylet killed it ("oom"); lets the task
+        # submitter surface a typed retriable OutOfMemoryError instead of
+        # a generic crash (reference: worker_killing_policy.h + the
+        # OOM-kill task-failure reason in node_manager.cc).
+        self._death_causes: Dict[str, str] = {}
 
     @property
     def address(self) -> str:
@@ -137,6 +148,10 @@ class Raylet:
         await self._gcs.subscribe("node", self._on_node_update)
         await self._gcs.subscribe("job", self._on_job_update)
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
+        if ray_config().memory_monitor_refresh_ms > 0:
+            self._tasks.append(asyncio.ensure_future(
+                self._memory_monitor_loop()))
+        self._tasks.append(asyncio.ensure_future(self._log_monitor_loop()))
         # Prestart a few workers so first-task latency is registration-bound,
         # not fork/exec-bound (reference: PrestartWorkers,
         # node_manager.cc:1782).
@@ -218,6 +233,115 @@ class Raylet:
             self._spill_infeasible_pending()
             await asyncio.sleep(period)
 
+    # -- OOM defense (reference: memory_monitor.h:52 +
+    # worker_killing_policy.h:34) ---------------------------------------
+    def _oom_candidates(self):
+        from ray_tpu.core.memory_monitor import WorkerCandidate
+
+        out = []
+        for w in self._workers.values():
+            if w.proc.poll() is not None or w.state not in ("leased",
+                                                            "actor"):
+                continue
+            conn = None
+            if w.lease_id is not None:
+                pair = self._lease_conns.get(w.lease_id)
+                conn = pair[1].conn_id if pair else None
+            out.append(WorkerCandidate(
+                worker_id=w.worker_id, pid=w.proc.pid,
+                task_id=w.actor_id or w.lease_id,
+                owner_address=(f"actor:{w.actor_id}" if w.actor_id
+                               else f"conn:{conn}"),
+                granted_at=w.granted_at,
+                # Plain leased tasks are retriable (the submitter's
+                # retry loop re-runs them); actors restart through
+                # their own max_restarts machinery — last resort.
+                retriable=w.actor_id is None))
+        return out
+
+    async def _memory_monitor_loop(self) -> None:
+        from ray_tpu.core.memory_monitor import MemoryMonitor
+
+        cfg = ray_config()
+        monitor = MemoryMonitor(cfg.memory_usage_threshold,
+                                self._oom_candidates)
+        period = cfg.memory_monitor_refresh_ms / 1000.0
+        while True:
+            await asyncio.sleep(period)
+            try:
+                victim = monitor.tick()
+            except Exception:
+                logger.warning("memory monitor tick failed",
+                               exc_info=True)
+                continue
+            if victim is None:
+                continue
+            worker = self._workers.get(victim.worker_id)
+            if worker is not None and worker.proc.poll() is None:
+                self._death_causes[worker.worker_id] = "oom"
+                while len(self._death_causes) > 256:
+                    self._death_causes.pop(next(iter(self._death_causes)))
+                worker.proc.kill()  # _monitor_worker reclaims the lease
+
+    async def handle_worker_death_cause(self, conn: ServerConnection, *,
+                                        worker_id: str) -> Optional[str]:
+        return self._death_causes.get(worker_id)
+
+    # -- worker log streaming (reference: _private/log_monitor.py:103
+    # tails per-worker files and publishes over GCS pubsub; drivers
+    # print via _private/worker.py:812) ---------------------------------
+    def _collect_new_log_lines(self) -> List[Dict[str, Any]]:
+        entries = []
+        for w in self._workers.values():
+            if not w.log_path:
+                continue
+            try:
+                size = os.path.getsize(w.log_path)
+                if size <= w.log_offset:
+                    continue
+                with open(w.log_path, "rb") as f:
+                    f.seek(w.log_offset)
+                    chunk = f.read(min(size - w.log_offset, 1 << 20))
+            except OSError:
+                continue
+            # Ship whole lines only; a partial trailing line waits for
+            # its newline (next tick). A full 1 MiB chunk with no newline
+            # is a pathological line: ship it truncated rather than
+            # re-reading the same megabyte forever.
+            cut = chunk.rfind(b"\n")
+            if cut < 0:
+                if len(chunk) < (1 << 20):
+                    continue
+                cut = len(chunk) - 1
+            w.log_offset += cut + 1
+            lines = chunk[:cut].decode("utf-8", "replace").splitlines()
+            if len(lines) > 200:
+                dropped = len(lines) - 200
+                lines = [f"... [{dropped} lines truncated by the log "
+                         f"monitor]"] + lines[-200:]
+            if lines:
+                entries.append({
+                    "worker_id": w.worker_id, "pid": w.proc.pid,
+                    "actor_id": w.actor_id,
+                    # Tag with the job the worker serves so a driver
+                    # only prints ITS workers (cross-driver isolation).
+                    "job_id": w.actor_job_id or w.lease_job_id,
+                    "lines": lines,
+                })
+        return entries
+
+    async def _log_monitor_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.3)
+            try:
+                entries = self._collect_new_log_lines()
+                if entries:
+                    await self._gcs.publish(
+                        "worker_logs",
+                        {"node_id": self.node_id, "entries": entries})
+            except Exception:
+                logger.debug("log monitor tick failed", exc_info=True)
+
     # A lease queued this long on a locally-feasible-but-busy node gets
     # re-spilled to a remote with room (reference: the cluster task
     # manager re-evaluates queued work against the cluster view; without
@@ -290,17 +414,25 @@ class Raylet:
         worker_id = uuid.uuid4().hex
         env = dict(os.environ)
         env["RAY_TPU_NODE_ID"] = self.node_id
+        # Unbuffered stdio: a task's print() must reach the log file (and
+        # the driver, via the log monitor) while the task runs, not when
+        # the worker exits.
+        env["PYTHONUNBUFFERED"] = "1"
         cmd = [sys.executable, "-m", "ray_tpu.core.worker_main",
                "--raylet", self.address, "--gcs", self.gcs_address,
                "--worker-id", worker_id, "--node-id", self.node_id]
+        # Workers ALWAYS log to a file: the log monitor tails these and
+        # streams lines to drivers (reference: log_monitor.py:103).
         log_dir = os.environ.get("RAY_TPU_LOG_DIR")
-        if log_dir:
-            out = open(os.path.join(log_dir, f"worker-{worker_id[:8]}.log"),
-                       "ab")
-        else:
-            out = subprocess.DEVNULL
+        if not log_dir:
+            log_dir = f"/tmp/ray_tpu_worker_logs_{self.node_id[:8]}"
+            os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(log_dir, f"worker-{worker_id[:8]}.log")
+        out = open(log_path, "ab")
         proc = subprocess.Popen(cmd, env=env, stdout=out, stderr=out)
+        out.close()  # the child holds the fd; the tailer reopens by path
         worker = _Worker(worker_id, proc)
+        worker.log_path = log_path
         self._workers[worker_id] = worker
         self._monitors[worker_id] = asyncio.ensure_future(
             self._monitor_worker(worker))
@@ -380,7 +512,8 @@ class Raylet:
             scheduling_key: str = "", is_actor: bool = False,
             spillback_count: int = 0,
             bundle: Optional[List[Any]] = None,
-            request_id: Optional[str] = None) -> Dict[str, Any]:
+            request_id: Optional[str] = None,
+            job_id: Optional[str] = None) -> Dict[str, Any]:
         demand = {k: float(v) for k, v in resources.items() if v}
         if logger.isEnabledFor(logging.DEBUG):
             logger.debug(
@@ -404,7 +537,8 @@ class Raylet:
                                   f"{b.total}"}
             pending = _PendingLease(demand, is_actor, scheduling_key,
                                     bundle_key=key, request_id=request_id,
-                                    spillback_count=spillback_count)
+                                    spillback_count=spillback_count,
+                                    job_id=job_id)
             pending.conn = conn
             self._pending.append(pending)
             self._try_dispatch()
@@ -430,7 +564,8 @@ class Raylet:
         # registering); the heartbeat loop re-evaluates them for spillback.
         pending = _PendingLease(demand, is_actor, scheduling_key,
                                 request_id=request_id,
-                                spillback_count=spillback_count)
+                                spillback_count=spillback_count,
+                                job_id=job_id)
         pending.conn = conn
         self._pending.append(pending)
         self._try_dispatch()
@@ -549,8 +684,10 @@ class Raylet:
                     # PrestartWorkers on the lease path).
                     starting = sum(1 for w in self._workers.values()
                                    if w.state == "starting")
+                    want_actor = any(p.is_actor for p in self._pending)
                     for _ in range(len(self._pending) - starting):
-                        if not self._can_start_worker():
+                        if not self._can_start_worker(
+                                for_actor=want_actor):
                             break
                         self._spawn_worker()
                     break
@@ -566,6 +703,8 @@ class Raylet:
                 lease_id = f"{self.node_id[:8]}-{self._next_lease}"
                 worker.state = "actor" if pending.is_actor else "leased"
                 worker.lease_id = lease_id
+                worker.granted_at = time.monotonic()
+                worker.lease_job_id = pending.job_id
                 worker.held = dict(pending.demand)
                 worker.bundle_key = pending.bundle_key
                 worker.chip_ids = chips
@@ -597,9 +736,16 @@ class Raylet:
                 return worker
         return None
 
-    def _can_start_worker(self) -> bool:
+    def _can_start_worker(self, for_actor: bool = False) -> bool:
+        """The soft limit caps the TASK worker pool; actors hold
+        dedicated workers for their lifetime and must not be starved by
+        it (reference: worker_pool.h — the cap applies to pooled idle
+        workers, dedicated actor workers allocate past it). Actor
+        spawns are still bounded against runaways."""
         limit = ray_config().num_workers_soft_limit or int(
             self.resources_total.get("CPU", 4)) + 2
+        if for_actor:
+            limit = max(limit * 8, 64)
         alive = sum(1 for w in self._workers.values() if w.state != "dead")
         return alive < limit
 
@@ -792,7 +938,20 @@ class Raylet:
 
     async def handle_seal_object(self, conn: ServerConnection, *,
                                  oid: str) -> bool:
-        self.store.seal(oid)
+        # Sealing is a fire-and-forget notify on the put hot path, so a
+        # failure cannot surface at the caller — make it loud here and
+        # drop the unsealed entry so consumers fail fast (object-lost ->
+        # lineage) instead of polling an object that will never seal.
+        try:
+            self.store.seal(oid)
+        except Exception as e:  # noqa: BLE001
+            logger.error("seal_object(%s) failed: %s; dropping entry",
+                         oid[:16], e)
+            try:
+                self.store.delete(oid)
+            except Exception:
+                pass
+            return False
         return True
 
     async def handle_object_info(self, conn: ServerConnection, *,

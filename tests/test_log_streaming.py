@@ -1,0 +1,79 @@
+"""Worker stdout/stderr streaming to the driver.
+
+Reference coverage class: `python/ray/tests/test_output.py` — remote
+prints and uncaught exceptions must appear in the driver's output
+(log_monitor.py tail -> GCS pubsub -> worker.py print_logs).
+"""
+
+import time
+
+import pytest
+
+pytestmark = pytest.mark.cluster
+
+
+def test_remote_print_and_uncaught_exception_reach_driver(capfd):
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    try:
+        @ray_tpu.remote
+        def chatty():
+            print("hello-from-worker-421")
+            return 1
+
+        @ray_tpu.remote
+        class Crashy:
+            def boom_in_thread(self):
+                import threading
+
+                def die():
+                    raise RuntimeError("uncaught-actor-thread-867")
+
+                t = threading.Thread(target=die)
+                t.start()
+                t.join()
+                return True
+
+        assert ray_tpu.get(chatty.remote(), timeout=120) == 1
+        a = Crashy.remote()
+        assert ray_tpu.get(a.boom_in_thread.remote(), timeout=120)
+
+        # The log monitor ticks at 300 ms; give a few rounds.
+        deadline = time.monotonic() + 15
+        out = err = ""
+        while time.monotonic() < deadline:
+            o, e = capfd.readouterr()
+            out += o
+            err += e
+            if ("hello-from-worker-421" in out + err
+                    and "uncaught-actor-thread-867" in out + err):
+                break
+            time.sleep(0.5)
+        combined = out + err
+        assert "hello-from-worker-421" in combined, \
+            "remote print never reached the driver"
+        assert "uncaught-actor-thread-867" in combined, \
+            "uncaught exception traceback never reached the driver"
+        assert "pid=" in combined  # prefixed with the worker identity
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_log_to_driver_false_stays_quiet(capfd):
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, log_to_driver=False,
+                 ignore_reinit_error=True)
+    try:
+        @ray_tpu.remote
+        def chatty():
+            print("should-not-stream-996")
+            return 2
+
+        assert ray_tpu.get(chatty.remote(), timeout=120) == 2
+        time.sleep(2.0)
+        out, err = capfd.readouterr()
+        assert "should-not-stream-996" not in out + err
+    finally:
+        ray_tpu.shutdown()
