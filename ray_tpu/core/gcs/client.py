@@ -33,6 +33,7 @@ class _ReconnectingRpc:
         self._subscribed: set = set()
         self._reconnect_lock: Optional[asyncio.Lock] = None
         self._closed = False
+        self._cluster_id: Optional[str] = None
 
     @property
     def connected(self) -> bool:
@@ -41,6 +42,11 @@ class _ReconnectingRpc:
     async def connect(self, timeout: float = 10.0) -> None:
         self._reconnect_lock = asyncio.Lock()
         await self._client.connect(timeout=timeout)
+        try:
+            self._cluster_id = await self._client.call("cluster_id",
+                                                       timeout=10.0)
+        except Exception:
+            self._cluster_id = None
 
     async def close(self) -> None:
         self._closed = True
@@ -78,6 +84,15 @@ class _ReconnectingRpc:
                     await fresh.connect(
                         timeout=min(5.0, max(0.5,
                                              deadline - loop.time())))
+                    if self._cluster_id:
+                        # Ephemeral-port reuse: whoever answers on the
+                        # cached address must be OUR cluster, not a new
+                        # one that grabbed the freed port.
+                        cid = await fresh.call("cluster_id", timeout=5.0)
+                        if cid != self._cluster_id:
+                            raise ConnectionLost(
+                                f"{self.address} now serves a different "
+                                f"cluster ({cid[:8]}…)")
                     for ch, h in self._push_handlers.items():
                         fresh.on_push(ch, h)
                     old, self._client = self._client, fresh

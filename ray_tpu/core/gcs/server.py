@@ -63,12 +63,31 @@ class GcsServer:
 
     async def start(self) -> None:
         self._load_storage()
+        # Cluster identity: ephemeral ports get reused across test
+        # clusters on one box, and a reconnecting client could silently
+        # adopt a FOREIGN cluster that happens to listen on its cached
+        # address. The id survives GCS restarts (persisted in kv) so
+        # legitimate FT reconnects still pass the check (reference: the
+        # cluster ID stamped into every GCS connection, gcs_client).
+        import uuid
+
+        cid = self.kv.get("__cluster_id__")
+        if cid is None:
+            self.cluster_id = uuid.uuid4().hex
+            self.kv["__cluster_id__"] = self.cluster_id.encode()
+            self.mark_dirty()
+        else:
+            self.cluster_id = (cid.decode() if isinstance(cid, bytes)
+                               else str(cid))
         await self._rpc.start()
         self._health_task = asyncio.ensure_future(self._health_loop())
         if self._storage_path:
             self._snapshot_task = asyncio.ensure_future(
                 self._snapshot_loop())
         logger.info("GCS listening on %s", self.address)
+
+    async def handle_cluster_id(self, conn: ServerConnection) -> str:
+        return self.cluster_id
 
     # -- durable storage (reference: gcs_table_storage.h over a store
     # client; here an atomic pickle snapshot, debounced at 1 Hz) --------
@@ -352,7 +371,14 @@ class GcsServer:
         if info.get("state") == "DEAD":
             name = info.get("name")
             ns = info.get("namespace") or "default"
-            if name and self.named_actors.get(f"{ns}/{name}") == actor_id:
+            # A restartable actor keeps its name through death: its owner
+            # may revive it (reference: gcs_actor_manager.h RESTARTING
+            # keeps the registration). Intentional kills and
+            # non-restartable actors free the name immediately.
+            restartable = (info.get("max_restarts", 0) != 0
+                           and updates.get("death_cause") != "ray.kill")
+            if (name and not restartable
+                    and self.named_actors.get(f"{ns}/{name}") == actor_id):
                 del self.named_actors[f"{ns}/{name}"]
         return True
 
