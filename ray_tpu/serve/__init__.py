@@ -166,8 +166,15 @@ def _get_or_create_controller():
         return ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:
         pass
+    # Detached + infinitely restartable: the control plane survives both
+    # its creating driver and its own crashes; state comes back from the
+    # GCS KV checkpoint (reference: serve's detached controller with
+    # GCS-checkpointed state, _private/controller.py:87).
+    # Generous concurrency: every live router long-polls listen_for_change
+    # and each poll occupies a slot for its full wait.
     actor_cls = ray_tpu.remote(num_cpus=0, name=CONTROLLER_NAME,
-                               max_concurrency=32)(ServeController)
+                               max_concurrency=128, max_restarts=-1,
+                               lifetime="detached")(ServeController)
     try:
         return actor_cls.remote()
     except Exception:

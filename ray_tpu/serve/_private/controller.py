@@ -46,19 +46,124 @@ class _DeploymentState:
     _scale_low_since: Optional[float] = None
 
 
+CHECKPOINT_KEY = "serve:controller_ckpt"
+
+
 class ServeController:
     def __init__(self):
         self._deployments: Dict[str, _DeploymentState] = {}
         self._routes: Dict[str, str] = {}   # route_prefix -> deployment
+        self._routes_version = 0
         self._shutdown = False
         # The ctor runs off the actor event loop; the reconcile task is
         # created lazily from the first async call, which does run on it.
         self._loop_task = None
+        self._ckpt_fingerprint: Any = None
+        # Crash recovery (reference: controller.py:87 — state is
+        # checkpointed to GCS KV and reloaded on restart; replicas are
+        # detached named actors that the new incarnation re-adopts).
+        try:
+            self._recover()
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
 
     def _ensure_reconciler(self) -> None:
         if self._loop_task is None or self._loop_task.done():
             self._loop_task = asyncio.get_running_loop().create_task(
                 self._reconcile_loop())
+
+    # -- durability -----------------------------------------------------
+    @staticmethod
+    def _kv_put(key: str, blob: bytes) -> None:
+        from ray_tpu.core.worker import current_runtime
+
+        rt = current_runtime()
+        rt._loop.run(rt._gcs.kv_put(key, blob, True), timeout=10)
+
+    @staticmethod
+    def _kv_get(key: str):
+        from ray_tpu.core.worker import current_runtime
+
+        rt = current_runtime()
+        return rt._loop.run(rt._gcs.kv_get(key), timeout=10)
+
+    def _fingerprint(self):
+        return (
+            self._routes_version,
+            tuple(sorted(
+                (n, st.target_replicas, st.route_version,
+                 tuple(sorted((r.replica_id, r.state)
+                              for r in st.replicas)))
+                for n, st in self._deployments.items())),
+        )
+
+    def _save_checkpoint(self) -> None:
+        """Persist target state + the live replica set to GCS KV on
+        every mutation; cheap no-op when nothing changed."""
+        fp = self._fingerprint()
+        if fp == self._ckpt_fingerprint:
+            return
+        import cloudpickle
+
+        blob = cloudpickle.dumps({
+            "routes": dict(self._routes),
+            "routes_version": self._routes_version,
+            "deployments": {
+                name: {
+                    "cls_factory": st.cls_factory,
+                    "init_args": st.init_args,
+                    "init_kwargs": st.init_kwargs,
+                    "config": st.config,
+                    "target_replicas": st.target_replicas,
+                    # route_version must survive restarts: listeners
+                    # hold the old incarnation's counters, and a reset
+                    # counter would never exceed them — their long-polls
+                    # would go silent forever.
+                    "route_version": st.route_version,
+                    "replicas": [(r.replica_id, r.version, r.state)
+                                 for r in st.replicas],
+                } for name, st in self._deployments.items()},
+        })
+        try:
+            self._kv_put(CHECKPOINT_KEY, blob)
+            self._ckpt_fingerprint = fp
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+
+    def _recover(self) -> None:
+        import cloudpickle
+
+        blob = self._kv_get(CHECKPOINT_KEY)
+        if not blob:
+            return
+        import ray_tpu
+
+        data = cloudpickle.loads(blob)
+        self._routes = dict(data.get("routes", {}))
+        self._routes_version = data.get("routes_version", 0) + 1
+        for name, d in data.get("deployments", {}).items():
+            st = _DeploymentState(
+                name=name, cls_factory=d["cls_factory"],
+                init_args=tuple(d["init_args"]),
+                init_kwargs=dict(d["init_kwargs"]),
+                config=d["config"],
+                target_replicas=d["target_replicas"])
+            for rid, version, rstate in d.get("replicas", ()):
+                if rstate != "RUNNING":
+                    continue  # half-started replicas restart fresh
+                try:
+                    handle = ray_tpu.get_actor(f"SERVE_REPLICA::{rid}")
+                except Exception:
+                    continue  # died with the old controller's node
+                st.replicas.append(_ReplicaState(
+                    handle=handle, replica_id=rid, version=version,
+                    state="RUNNING"))
+            st.route_version = d.get("route_version", 0) + 1
+            self._deployments[name] = st
 
     # -- API (driver / serve.run) --------------------------------------
     async def deploy(self, name: str, cls_factory, init_args, init_kwargs,
@@ -66,6 +171,21 @@ class ServeController:
         """Create or update a deployment. A changed version triggers a
         rolling update; a changed num_replicas scales."""
         self._ensure_reconciler()
+        if config.version is None:
+            # Auto-version from the code + constructor args so an
+            # unversioned redeploy with changes still rolls (reference:
+            # serve computes a config/code version hash when the user
+            # does not pin one).
+            import hashlib
+
+            import cloudpickle
+
+            try:
+                blob = cloudpickle.dumps(
+                    (cls_factory, init_args, init_kwargs))
+                config.version = hashlib.sha1(blob).hexdigest()[:12]
+            except Exception:
+                pass  # unpicklable corner: keep None (no auto-roll)
         existing = self._deployments.get(name)
         target = (config.autoscaling_config.min_replicas
                   if config.autoscaling_config else config.num_replicas)
@@ -84,18 +204,25 @@ class ServeController:
                 existing.target_replicas = config.num_replicas
             elif old_autoscaling is None:
                 existing.target_replicas = target
-        if route_prefix is not None:
+        if route_prefix is not None and \
+                self._routes.get(route_prefix) != name:
             self._routes[route_prefix] = name
+            self._routes_version += 1
+        self._save_checkpoint()
         return True
 
     async def delete_deployment(self, name: str) -> bool:
         state = self._deployments.pop(name, None)
         if state is None:
             return False
-        self._routes = {r: d for r, d in self._routes.items() if d != name}
+        if any(d == name for d in self._routes.values()):
+            self._routes = {r: d for r, d in self._routes.items()
+                            if d != name}
+            self._routes_version += 1
         await asyncio.gather(
             *[self._stop_replica(state, r) for r in list(state.replicas)],
             return_exceptions=True)
+        self._save_checkpoint()
         return True
 
     async def get_routing_table(self, name: str) -> Dict[str, Any]:
@@ -114,6 +241,49 @@ class ServeController:
     async def get_routes(self) -> Dict[str, str]:
         return dict(self._routes)
 
+    async def listen_for_change(self, versions: Dict[str, int],
+                                timeout_s: float = 10.0) -> Dict[str, Any]:
+        """Long-poll (reference: long_poll.py:174 LongPollHost): blocks
+        until the route table or any listed deployment's routing version
+        moves past the caller's snapshot, or timeout_s elapses; returns
+        the changed snapshots. `versions` maps "__routes__" and
+        deployment names to the caller's last-seen versions."""
+        self._ensure_reconciler()
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+
+        def changed() -> Dict[str, Any]:
+            out: Dict[str, Any] = {}
+            if self._routes_version > versions.get("__routes__", -1):
+                out["__routes__"] = {"version": self._routes_version,
+                                     "routes": dict(self._routes)}
+            for name, seen in versions.items():
+                if name == "__routes__":
+                    continue
+                st = self._deployments.get(name)
+                if st is None:
+                    if seen != -1:
+                        # Deleted: tell the listener to STOP polling —
+                        # otherwise dead-deployment pollers pile up and
+                        # exhaust the controller's concurrency slots.
+                        out[name] = {"version": -1, "replicas": [],
+                                     "deleted": True}
+                    continue
+                if st.route_version > seen:
+                    out[name] = {
+                        "version": st.route_version,
+                        "replicas": [(r.replica_id, r.handle)
+                                     for r in st.replicas
+                                     if r.state == "RUNNING"],
+                    }
+            return out
+
+        while True:
+            out = changed()
+            if out or loop.time() >= deadline or self._shutdown:
+                return out
+            await asyncio.sleep(0.05)
+
     async def status(self) -> Dict[str, Any]:
         out = {}
         for name, st in self._deployments.items():
@@ -130,6 +300,13 @@ class ServeController:
         self._shutdown = True
         for state in list(self._deployments.values()):
             await self.delete_deployment(state.name)
+        # A later serve instance must start empty, not adopt this one.
+        try:
+            import cloudpickle
+
+            self._kv_put(CHECKPOINT_KEY, cloudpickle.dumps({}))
+        except Exception:
+            pass
         return True
 
     # -- reconciliation -------------------------------------------------
@@ -139,6 +316,9 @@ class ServeController:
                 for state in list(self._deployments.values()):
                     await self._reconcile(state)
                     await self._autoscale(state)
+                # Replica-set / autoscale changes persist too, so a
+                # restarted controller re-adopts the same live actors.
+                self._save_checkpoint()
             except Exception:
                 import traceback
 
@@ -239,6 +419,11 @@ class ServeController:
         opts.setdefault("num_cpus", 0)
         opts.setdefault("max_concurrency",
                         state.config.max_ongoing_requests)
+        # Detached + named: replicas survive a controller crash and the
+        # restarted controller re-adopts them by name (reference:
+        # deployment_state.py ActorReplicaWrapper named actors).
+        opts.setdefault("name", f"SERVE_REPLICA::{replica_id}")
+        opts.setdefault("lifetime", "detached")
         actor_cls = ray_tpu.remote(**opts)(Replica)
         handle = actor_cls.remote(
             state.cls_factory, state.init_args, state.init_kwargs,

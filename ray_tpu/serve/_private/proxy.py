@@ -36,14 +36,28 @@ class HTTPProxy:
             self._refresh_routes_loop())
         return self.port
 
+    _routes_version = -1
+
     async def _refresh_routes_loop(self) -> None:
+        """Long-poll the controller for route-table pushes (reference:
+        long_poll.py LongPollClient); on controller outage keep serving
+        the cached table and retry."""
         while True:
             try:
-                self._routes = await asyncio.to_thread(
-                    self._get_routes_blocking)
+                out = await asyncio.to_thread(self._listen_blocking)
+                snap = (out or {}).get("__routes__")
+                if snap:
+                    self._routes = snap["routes"]
+                    self._routes_version = snap["version"]
             except Exception:
-                pass
-            await asyncio.sleep(1.0)
+                await asyncio.sleep(1.0)  # controller restarting
+
+    def _listen_blocking(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        return ray_tpu.get(self._controller.listen_for_change.remote(
+            {"__routes__": self._routes_version}, timeout_s=10.0),
+            timeout=20)
 
     def _get_routes_blocking(self) -> Dict[str, str]:
         import ray_tpu
