@@ -119,9 +119,20 @@ class Dataset:
                         for i in builtins.range(num_blocks)])
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """Global shuffle (materializing all-to-all, like the
-        reference's random_shuffle)."""
+        """Global shuffle: a distributed two-stage task exchange when a
+        cluster is up (reference: _internal/push_based_shuffle.py — the
+        driver holds only refs, never rows); in-process otherwise."""
         self._check_not_limited("random_shuffle")
+        import ray_tpu
+
+        if ray_tpu.is_initialized():
+            from ray_tpu.data.shuffle import (block_ref_reader,
+                                              distributed_random_shuffle)
+
+            refs = distributed_random_shuffle(
+                self._read_tasks, self._transforms, seed,
+                max(1, len(self._read_tasks)))
+            return Dataset([block_ref_reader(r) for r in refs])
         block = self.materialize()
         total = block_num_rows(block)
         rng = np.random.default_rng(seed)
@@ -137,8 +148,20 @@ class Dataset:
                         for i in builtins.range(n_blocks)])
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        """Global sort by column (materializing all-to-all)."""
+        """Global sort by column: sample -> range-partition -> per-part
+        sort when a cluster is up (parts concatenate in key order);
+        in-process otherwise."""
         self._check_not_limited("sort")
+        import ray_tpu
+
+        if ray_tpu.is_initialized():
+            from ray_tpu.data.shuffle import (block_ref_reader,
+                                              distributed_sort)
+
+            refs = distributed_sort(
+                self._read_tasks, self._transforms, key, descending,
+                max(1, len(self._read_tasks)))
+            return Dataset([block_ref_reader(r) for r in refs])
         block = self.materialize()
         order = np.argsort(np.asarray(block[key]), kind="stable")
         if descending:
@@ -261,49 +284,69 @@ class Dataset:
 
 
 class GroupedData:
-    """Reference: grouped_data.py — hash-grouped aggregations."""
+    """Reference: grouped_data.py — hash-grouped aggregations. On a
+    cluster, aggregation is a distributed hash exchange (a group never
+    spans reducers); the driver handles only refs and, for the small
+    named aggregates, the final per-group rows for global key order."""
 
     def __init__(self, ds: Dataset, key: str):
         self._ds = ds
         self._key = key
 
-    def _groups(self) -> Dict[Any, List[Dict[str, Any]]]:
+    def _agg(self, kind: str, on: Optional[str] = None,
+             fn: Optional[Callable] = None) -> Dataset:
+        import ray_tpu
+
+        if ray_tpu.is_initialized():
+            from ray_tpu.data.shuffle import (block_ref_reader,
+                                              distributed_group_agg)
+
+            refs = distributed_group_agg(
+                self._ds._read_tasks, self._ds._transforms, self._key,
+                kind, on, fn, max(1, len(self._ds._read_tasks)))
+            out = Dataset([block_ref_reader(r) for r in refs])
+            if kind == "map_groups":
+                # Output may be data-sized: keep it distributed,
+                # partition order (not key order, like the reference).
+                return out
+            # Named aggregates are O(groups), not O(rows): collect and
+            # restore the global key order the local path produces.
+            rows = []
+            for b in out.iter_blocks():
+                rows.extend(block_to_rows(b))
+            try:
+                rows.sort(key=lambda r: r[self._key])
+            except TypeError:
+                pass  # unorderable keys keep partition order
+            return Dataset([lambda rows=rows: block_from_rows(rows)])
+        # In-process fallback (no cluster).
         groups: Dict[Any, List[Dict[str, Any]]] = {}
         for row in self._ds.iter_rows():
             groups.setdefault(row[self._key], []).append(row)
-        return groups
-
-    def _ordered(self):
-        """Sorted by key when orderable, else insertion order (mixed or
-        None keys must group, not crash)."""
-        groups = self._groups()
         try:
-            return sorted(groups.items())
+            ordered = sorted(groups.items())
         except TypeError:
-            return list(groups.items())
+            ordered = list(groups.items())
+        from ray_tpu.data.shuffle import GroupAggFinalize
+
+        rows: List[Dict[str, Any]] = []
+        agg = GroupAggFinalize(self._key, kind, on, fn)
+        for k, grp in ordered:
+            rows.extend(block_to_rows(agg(block_from_rows(grp), 0)))
+        return Dataset([lambda rows=rows: block_from_rows(rows)])
 
     def count(self) -> Dataset:
-        rows = [{self._key: k, "count()": len(v)}
-                for k, v in self._ordered()]
-        return Dataset([lambda rows=rows: block_from_rows(rows)])
+        return self._agg("count")
 
     def sum(self, on: str) -> Dataset:
-        rows = [{self._key: k, f"sum({on})": sum(r[on] for r in v)}
-                for k, v in self._ordered()]
-        return Dataset([lambda rows=rows: block_from_rows(rows)])
+        return self._agg("sum", on)
 
     def mean(self, on: str) -> Dataset:
-        rows = [{self._key: k,
-                 f"mean({on})": sum(r[on] for r in v) / len(v)}
-                for k, v in self._ordered()]
-        return Dataset([lambda rows=rows: block_from_rows(rows)])
+        return self._agg("mean", on)
 
     def map_groups(self, fn: Callable[[List[Dict[str, Any]]],
                                       List[Dict[str, Any]]]) -> Dataset:
-        rows: List[Dict[str, Any]] = []
-        for _, group in self._ordered():
-            rows.extend(fn(group))
-        return Dataset([lambda rows=rows: block_from_rows(rows)])
+        return self._agg("map_groups", fn=fn)
 
 
 # ---------------------------------------------------------------------
