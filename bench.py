@@ -90,6 +90,15 @@ def main() -> None:
         # In-band failure record: a missing north-star metric must be
         # distinguishable from a broken bench.
         notes["rl_bench_error"] = repr(e)
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.rllib.bench", "--image"],
+            capture_output=True, text=True, timeout=600,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        notes["rl_image_env_steps_per_sec"] = float(
+            out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        notes["rl_image_bench_error"] = repr(e)
 
     print(json.dumps({
         "metric": "lm_train_tokens_per_sec_per_chip",

@@ -19,8 +19,7 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
-from ray_tpu.rllib.algorithms.ppo import (_default_env_creator,
-                                          _probe_spaces)
+from ray_tpu.rllib.algorithms.ppo import _default_env_creator
 
 
 @dataclass
@@ -40,6 +39,9 @@ class IMPALAConfig:
     vf_coeff: float = 0.5
     entropy_coeff: float = 0.01
     hiddens: tuple = (64, 64)
+    # "auto" routes 3-D (image) observations to the conv module,
+    # flat ones to the MLP (reference: models/catalog.py).
+    model: str = "auto"
     seed: int = 0
     platform: Optional[str] = None
     # APPO switch: clipped-surrogate policy loss over v-trace advantages.
@@ -76,20 +78,21 @@ class IMPALA:
 
     def __init__(self, config: IMPALAConfig):
         import ray_tpu
+        from ray_tpu.rllib.algorithms.ppo import _probe_env
         from ray_tpu.rllib.core.impala_learner import ImpalaLearner
-        from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
+        from ray_tpu.rllib.core.rl_module import make_discrete_module
         from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
 
         self.config = config
         env_creator = config.env_creator or _default_env_creator(config.env)
-        obs_dim, num_actions = _probe_spaces(env_creator)
+        obs_shape, num_actions = _probe_env(env_creator)
         hiddens = tuple(config.hiddens)
+        model = config.model
 
-        def module_factory(obs_dim=obs_dim, num_actions=num_actions,
-                           hiddens=hiddens):
-            return DiscreteMLPModule(obs_dim=obs_dim,
-                                     num_actions=num_actions,
-                                     hiddens=hiddens)
+        def module_factory(obs_shape=obs_shape, num_actions=num_actions,
+                           hiddens=hiddens, model=model):
+            return make_discrete_module(obs_shape, num_actions,
+                                        hiddens=hiddens, model=model)
 
         self.learner = ImpalaLearner(module_factory(),
                                      config.learner_config())

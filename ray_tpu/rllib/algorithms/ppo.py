@@ -69,6 +69,16 @@ def _probe_spaces(env_creator) -> tuple:
     return obs_dim, num_actions
 
 
+def _probe_env(env_creator) -> tuple:
+    """(obs_shape, num_actions) — shape preserved so the module catalog
+    can route image observations to the conv trunk."""
+    env = env_creator()
+    shape = tuple(int(s) for s in env.observation_space.shape)
+    num_actions = int(env.action_space.n)
+    env.close()
+    return shape, num_actions
+
+
 class PPO:
     """Reference: Algorithm (a Tune Trainable): `.train()` runs one
     iteration and returns metrics."""

@@ -30,5 +30,37 @@ def main() -> None:
         ray_tpu.shutdown()
 
 
+def main_image() -> None:
+    """IMPALA on 84x84x4 image observations through the conv RLModule —
+    the Atari-shaped pipeline (BASELINE north-star #3 class; ALE itself
+    needs egress, so the committed synthetic pixel env stands in)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ray_tpu
+    from ray_tpu.rllib.algorithms.impala import IMPALAConfig
+    from ray_tpu.rllib.env.synthetic_atari import SyntheticAtariEnv
+
+    ray_tpu.init(num_cpus=4)
+    algo = IMPALAConfig(
+        env_creator=lambda: SyntheticAtariEnv(max_blocks=8),
+        num_env_runners=2, num_envs_per_runner=2,
+        rollout_fragment_length=16, train_batch_fragments=2,
+        updates_per_iteration=6, platform="cpu").build()
+    try:
+        algo.train()  # warmup: spawn + conv compile
+        rates = sorted(algo.train()["env_steps_per_sec"]
+                       for _ in range(3))
+        print(round(rates[len(rates) // 2], 1), flush=True)
+    finally:
+        algo.stop()
+        ray_tpu.shutdown()
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--image" in sys.argv:
+        main_image()
+    else:
+        main()

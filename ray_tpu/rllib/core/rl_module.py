@@ -79,6 +79,71 @@ class DiscreteMLPModule:
         return logits, value
 
 
+@dataclass
+class DiscreteConvModule:
+    """Image obs -> {logits, vf}: Nature-CNN trunk shared by policy and
+    value heads (the reference's VisionNetwork default for Atari,
+    models/catalog.py — value shares the conv trunk because conv features
+    are expensive and generic, unlike the MLP case)."""
+
+    obs_shape: Tuple[int, int, int]   # (H, W, C)
+    num_actions: int
+    dense: int = 512
+
+    def __post_init__(self):
+        from ray_tpu.models.cnn import CNNConfig
+
+        h, w, c = self.obs_shape
+        self._cfg = CNNConfig(input_hw=(h, w), input_channels=c,
+                              dense=self.dense)
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        from ray_tpu.models.cnn import cnn_init
+
+        k_trunk, k_pi, k_vf = jax.random.split(rng, 3)
+        params = cnn_init(k_trunk, self._cfg)
+        params["pi_w_out"] = (jax.random.normal(
+            k_pi, (self.dense, self.num_actions), jnp.float32) * 0.01)
+        params["pi_b_out"] = jnp.zeros((self.num_actions,), jnp.float32)
+        params["vf_w_out"] = (jax.random.normal(
+            k_vf, (self.dense, 1), jnp.float32)
+            * jnp.sqrt(1.0 / self.dense))
+        params["vf_b_out"] = jnp.zeros((1,), jnp.float32)
+        return params
+
+    def apply(self, params: Dict[str, Any], obs: jax.Array
+              ) -> Tuple[jax.Array, jax.Array]:
+        """Returns (logits [B, A], value [B]); obs is (B, H, W, C)."""
+        from ray_tpu.models.cnn import cnn_apply
+
+        feat = cnn_apply(params, self._cfg, obs)
+        logits = feat @ params["pi_w_out"] + params["pi_b_out"]
+        value = (feat @ params["vf_w_out"] + params["vf_b_out"])[..., 0]
+        return logits, value
+
+
+def make_discrete_module(obs_shape, num_actions: int,
+                         hiddens: Sequence[int] = (64, 64),
+                         model: str = "auto"):
+    """Catalog entry point (reference: models/catalog.py get_model_v2):
+    image-shaped observations (3-D) get the conv module, flat ones the
+    MLP."""
+    import numpy as np
+
+    shape = tuple(int(s) for s in np.atleast_1d(obs_shape))
+    use_conv = (model == "conv"
+                or (model == "auto" and len(shape) == 3))
+    if use_conv:
+        if len(shape) != 3:
+            raise ValueError(
+                f"conv model needs (H, W, C) observations, got {shape}")
+        return DiscreteConvModule(obs_shape=shape,
+                                  num_actions=num_actions)
+    return DiscreteMLPModule(obs_dim=int(np.prod(shape)),
+                             num_actions=num_actions,
+                             hiddens=tuple(hiddens))
+
+
 def categorical_logp(logits: jax.Array, actions: jax.Array) -> jax.Array:
     logp_all = jax.nn.log_softmax(logits)
     return jnp.take_along_axis(logp_all, actions[:, None], axis=1)[:, 0]
