@@ -159,11 +159,27 @@ class StandardAutoscaler:
         logger.info("autoscaler launched %s node %s", nt.name,
                     node_id[:8])
 
+    @staticmethod
+    def _node_busy(info: Optional[dict]) -> bool:
+        if info is None:
+            return False
+        total = info.get("resources_total", {}) or info.get(
+            "Resources", {})
+        avail = info.get("resources_available", {})
+        busy = any(avail.get(k, 0.0) + 1e-9 < v
+                   for k, v in total.items()
+                   if k in ("CPU", "TPU"))
+        return busy or bool((info.get("load") or {}).get("pending"))
+
     def _reap_idle(self, alive: List[dict]) -> None:
         now = time.monotonic()
         by_id = {n["node_id"]: n for n in alive}
+        # A provider node may be a gang of raylets (a TPU slice):
+        # hosts_of maps it to its GCS node ids, and the gang is busy if
+        # ANY host is busy — slices terminate atomically or not at all.
+        hosts_of = getattr(self.provider, "hosts_of",
+                           lambda node_id: [node_id])
         for node_id in self.provider.non_terminated_nodes():
-            info = by_id.get(node_id)
             nt_name = self.launched.get(node_id)
             nt = next((t for t in self.config.node_types
                        if t.name == nt_name), None)
@@ -174,17 +190,8 @@ class StandardAutoscaler:
             if same_type <= floor:
                 self._idle_since.pop(node_id, None)
                 continue
-            busy = False
-            if info is not None:
-                total = info.get("resources_total", {}) or info.get(
-                    "Resources", {})
-                avail = info.get("resources_available", {})
-                busy = any(avail.get(k, 0.0) + 1e-9 < v
-                           for k, v in total.items()
-                           if k in ("CPU", "TPU"))
-                busy = busy or bool((info.get("load") or {}).get(
-                    "pending"))
-            if busy:
+            host_ids = hosts_of(node_id) or [node_id]
+            if any(self._node_busy(by_id.get(h)) for h in host_ids):
                 self._idle_since.pop(node_id, None)
                 continue
             first = self._idle_since.setdefault(node_id, now)
