@@ -33,7 +33,7 @@ def env_hash(runtime_env: Optional[Dict[str, Any]]) -> str:
 
 
 def validate(runtime_env: Dict[str, Any]) -> None:
-    allowed = {"env_vars", "working_dir", "working_dir_key"}
+    allowed = {"env_vars", "working_dir", "working_dir_key", "pip"}
     unknown = set(runtime_env) - allowed
     if unknown:
         raise ValueError(
@@ -44,6 +44,13 @@ def validate(runtime_env: Dict[str, Any]) -> None:
             isinstance(k, str) and isinstance(v, str)
             for k, v in env_vars.items()):
         raise ValueError("runtime_env env_vars must be {str: str}")
+    pip = runtime_env.get("pip")
+    if pip is not None and not (
+            isinstance(pip, (list, tuple))
+            and all(isinstance(r, str) for r in pip)):
+        raise ValueError(
+            "runtime_env pip must be a list of requirement strings "
+            "(wheel paths / source dirs work offline)")
 
 
 def pack_working_dir(path: str) -> bytes:
@@ -90,12 +97,69 @@ def prepare_spec_env(rt, runtime_env: Optional[Dict[str, Any]]
     return out
 
 
+# -- pip plugin (reference: _private/runtime_env/pip.py) -----------------
+_PIP_ROOT = os.path.join(_EXTRACT_ROOT, "pip")
+
+
+def pip_env_key(requirements) -> str:
+    """Content key: same requirement set -> same cached env."""
+    reqs = sorted(str(r) for r in requirements)
+    return hashlib.sha1("\n".join(reqs).encode()).hexdigest()[:16]
+
+
+def ensure_pip_env(requirements) -> str:
+    """Install `requirements` into a per-node cached target directory
+    keyed by the requirements hash; returns the directory. Reference
+    builds a full virtualenv per env (pip.py); here packages install
+    with `pip --target` and join sys.path — same isolation-by-
+    scheduling-key model, no interpreter restart. `--no-build-isolation`
+    keeps source installs working offline (zero-egress hosts)."""
+    import subprocess
+
+    key = pip_env_key(requirements)
+    target = os.path.join(_PIP_ROOT, key)
+    marker = os.path.join(target, ".ray_tpu_pip_done")
+    if os.path.exists(marker):
+        return target  # cache hit: another task on this node built it
+    tmp = f"{target}.tmp.{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    cmd = [sys.executable, "-m", "pip", "install", "--quiet",
+           "--no-build-isolation", "--target", tmp,
+           *sorted(str(r) for r in requirements)]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=600)
+    if proc.returncode != 0:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        from ray_tpu.exceptions import RuntimeEnvSetupError
+
+        raise RuntimeEnvSetupError(
+            f"pip install failed (rc={proc.returncode}):\n"
+            f"{proc.stderr[-2000:]}")
+    with open(os.path.join(tmp, ".ray_tpu_pip_done"), "w") as f:
+        f.write(key)
+    try:
+        os.rename(tmp, target)
+    except OSError:
+        # Concurrent install won the rename: use theirs.
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return target
+
+
 def apply_runtime_env(rt, runtime_env: Optional[Dict[str, Any]]) -> None:
     """Worker-side: make the env effective for this process."""
     if not runtime_env:
         return
     env_vars = runtime_env.get("env_vars") or {}
     os.environ.update(env_vars)
+    pip = runtime_env.get("pip")
+    if pip:
+        target = ensure_pip_env(pip)
+        if target not in sys.path:
+            sys.path.insert(0, target)
     key = runtime_env.get("working_dir_key")
     if key:
         target = os.path.join(_EXTRACT_ROOT, key.rsplit(":", 1)[-1])

@@ -17,11 +17,11 @@ class ActorPool:
         self._idle: List[Any] = list(actors)
         if not self._idle:
             raise ValueError("ActorPool needs at least one actor")
-        self._future_to_actor = {}
-        self._index_to_future = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits: List[tuple] = []
+        self._actor_of_ref = {}
+        self._ref_of_submit_idx = {}
+        self._submit_counter = 0
+        self._yield_counter = 0
+        self._backlog: List[tuple] = []
 
     # -- core ----------------------------------------------------------
     def submit(self, fn: Callable[[Any, V], Any], value: V) -> None:
@@ -29,24 +29,24 @@ class ActorPool:
         if self._idle:
             actor = self._idle.pop()
             future = fn(actor, value)
-            self._future_to_actor[future] = actor
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
+            self._actor_of_ref[future] = actor
+            self._ref_of_submit_idx[self._submit_counter] = future
+            self._submit_counter += 1
         else:
-            self._pending_submits.append((fn, value))
+            self._backlog.append((fn, value))
 
     def has_next(self) -> bool:
-        return bool(self._index_to_future)
+        return bool(self._ref_of_submit_idx)
 
     def get_next(self, timeout: float = None) -> Any:
         """Next result in submission order."""
         if not self.has_next():
             raise StopIteration("no pending results")
-        if self._next_return_index not in self._index_to_future:
+        if self._yield_counter not in self._ref_of_submit_idx:
             # Earlier indices were consumed by get_next_unordered: the
             # "next in order" is the smallest remaining submission index.
-            self._next_return_index = min(self._index_to_future)
-        future = self._index_to_future[self._next_return_index]
+            self._yield_counter = min(self._ref_of_submit_idx)
+        future = self._ref_of_submit_idx[self._yield_counter]
         import ray_tpu
 
         if timeout is not None:
@@ -56,12 +56,12 @@ class ActorPool:
                                     timeout=timeout)
             if not ready:
                 raise TimeoutError("get_next timed out")
-        del self._index_to_future[self._next_return_index]
-        self._next_return_index += 1
+        del self._ref_of_submit_idx[self._yield_counter]
+        self._yield_counter += 1
         try:
             return ray_tpu.get(future, timeout=timeout)
         finally:
-            self._return_actor(self._future_to_actor.pop(future))
+            self._return_actor(self._actor_of_ref.pop(future))
 
     def get_next_unordered(self, timeout: float = None) -> Any:
         """Next result in completion order."""
@@ -70,27 +70,27 @@ class ActorPool:
         import ray_tpu
 
         ready, _ = ray_tpu.wait(
-            list(self._future_to_actor), num_returns=1,
+            list(self._actor_of_ref), num_returns=1,
             timeout=timeout)
         if not ready:
             raise TimeoutError("get_next_unordered timed out")
         future = ready[0]
-        for idx, f in list(self._index_to_future.items()):
+        for idx, f in list(self._ref_of_submit_idx.items()):
             if f == future:
-                del self._index_to_future[idx]
+                del self._ref_of_submit_idx[idx]
                 break
         try:
             return ray_tpu.get(future, timeout=timeout)
         finally:
-            self._return_actor(self._future_to_actor.pop(future))
+            self._return_actor(self._actor_of_ref.pop(future))
 
     def _return_actor(self, actor) -> None:
-        if self._pending_submits:
-            fn, value = self._pending_submits.pop(0)
+        if self._backlog:
+            fn, value = self._backlog.pop(0)
             future = fn(actor, value)
-            self._future_to_actor[future] = actor
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
+            self._actor_of_ref[future] = actor
+            self._ref_of_submit_idx[self._submit_counter] = future
+            self._submit_counter += 1
         else:
             self._idle.append(actor)
 
@@ -112,7 +112,7 @@ class ActorPool:
     # -- membership ------------------------------------------------------
     def push(self, actor) -> None:
         self._idle.append(actor)
-        if self._pending_submits:
+        if self._backlog:
             self._return_actor(self._idle.pop())
 
     def pop_idle(self):
