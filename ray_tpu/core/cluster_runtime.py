@@ -676,11 +676,30 @@ class ClusterRuntime:
         with self._owned_lock:
             entry = self._owned.get(oid)
         if entry is not None:
-            try:
-                kind, payload = await asyncio.wait_for(
-                    asyncio.wrap_future(entry.fut), timeout)
-            except (asyncio.TimeoutError, TimeoutError):
-                raise GetTimeoutError(f"timed out waiting for {ref}")
+            # NOT wait_for: cancelling the wrapper on timeout propagates
+            # into entry.fut (wrap_future chains cancellation), which
+            # would permanently poison the ref — a later get() must
+            # still be able to succeed. Waiting is SLICED because
+            # reconstruction REPLACES entry.fut with a fresh Future
+            # without resolving the old one (the same trap
+            # _resolve_dependencies polls around): re-read the entry
+            # each slice so a reconstructed object still materializes.
+            while True:
+                wrapped = asyncio.wrap_future(entry.fut)
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - time.monotonic()))
+                slice_t = (0.5 if remaining is None
+                           else min(0.5, remaining))
+                done, _ = await asyncio.wait({wrapped}, timeout=slice_t)
+                if done:
+                    kind, payload = wrapped.result()
+                    break
+                if remaining is not None and remaining <= slice_t:
+                    raise GetTimeoutError(f"timed out waiting for {ref}")
+                with self._owned_lock:
+                    latest = self._owned.get(oid)
+                if latest is not None:
+                    entry = latest
             if kind == "inline":
                 return ("inline", payload, oid)
             # stored on some node; pull through the local raylet
@@ -1037,12 +1056,16 @@ class ClusterRuntime:
         self._inflight_task_workers[spec["task_id"]] = (
             worker["worker_address"], False)
         worker["pipeline"] = worker.get("pipeline", 0) + 1
+        push_t0 = time.monotonic()
         try:
             client = await self._worker_client(worker["worker_address"])
             # Pipelining: once the push is on the wire the lease goes
             # back into circulation (bounded by worker_pipeline_depth),
             # so the worker's execution queue stays fed across the
             # push/reply round trip instead of idling one RTT per task.
+            # _offer_worker gates this on the worker's observed service
+            # time — queueing behind a LONG task would serialize work
+            # that fresh leases (and spillback) could run in parallel.
             self._offer_worker(key, worker)
             reply = await client.call("push_task", spec=spec, timeout=None)
         except BaseException as push_err:
@@ -1065,6 +1088,12 @@ class ClusterRuntime:
         # failure _submit_async must still see it to suppress the retry.
         self._cancel_requested.discard(spec["task_id"])
         worker["pipeline"] -= 1
+        # Per-worker service-time EMA (push->reply, which bounds task
+        # duration): drives the deep-pipelining gate in _offer_worker.
+        span = time.monotonic() - push_t0
+        prev = worker.get("svc_ema")
+        worker["svc_ema"] = (span if prev is None
+                             else 0.7 * prev + 0.3 * span)
         self._record_task_reply(spec, reply)
         self._offer_worker(key, worker)
 
@@ -1157,14 +1186,26 @@ class ClusterRuntime:
         pool.inflight_leases -= 1
         self._hand_worker(pool, worker)
 
+    # Deep pipelining (offering a worker that is still executing) only
+    # pays off when tasks are shorter than a lease round trip; queueing
+    # behind a task slower than this serializes parallelizable work.
+    PIPELINE_SVC_THRESHOLD_S = 0.03
+
     def _offer_worker(self, key: str, worker: dict) -> None:
         """Put a leased worker (back) into circulation if it is alive,
-        not already circulating, and has pipeline window left."""
+        not already circulating, and has pipeline window left. Workers
+        whose tasks are slow (or of unknown duration beyond the first)
+        only circulate when their queue is empty — fresh leases and
+        spillback handle the parallelism instead."""
         if worker.get("dead") or worker.get("avail"):
             return
-        if (worker.get("pipeline", 0)
-                >= ray_config().worker_pipeline_depth):
+        pipeline = worker.get("pipeline", 0)
+        if pipeline >= ray_config().worker_pipeline_depth:
             return
+        if pipeline > 0:
+            ema = worker.get("svc_ema")
+            if ema is None or ema > self.PIPELINE_SVC_THRESHOLD_S:
+                return  # don't queue behind an unknown/slow task
         pool = self._lease_pools.setdefault(key, _LeasePool())
         self._hand_worker(pool, worker)
 

@@ -102,9 +102,35 @@ _PIP_ROOT = os.path.join(_EXTRACT_ROOT, "pip")
 
 
 def pip_env_key(requirements) -> str:
-    """Content key: same requirement set -> same cached env."""
-    reqs = sorted(str(r) for r in requirements)
-    return hashlib.sha1("\n".join(reqs).encode()).hexdigest()[:16]
+    """Content key: same requirement set -> same cached env. Local
+    source/wheel requirements fold in their file stats, so editing the
+    package invalidates the cache instead of serving a stale install."""
+    parts = []
+    for r in sorted(str(r) for r in requirements):
+        parts.append(r)
+        if os.path.exists(r):
+            if os.path.isdir(r):
+                for root, dirs, files in os.walk(r):
+                    # Exclude what pip's in-tree build writes back
+                    # (egg-info, build/, dist/) or the key would change
+                    # after the first install and never cache-hit.
+                    dirs[:] = sorted(
+                        d for d in dirs
+                        if d not in ("__pycache__", ".git", "build",
+                                     "dist")
+                        and not d.endswith(".egg-info"))
+                    for fname in sorted(files):
+                        full = os.path.join(root, fname)
+                        try:
+                            st = os.stat(full)
+                            parts.append(
+                                f"{full}:{st.st_mtime_ns}:{st.st_size}")
+                        except OSError:
+                            pass
+            else:
+                st = os.stat(r)
+                parts.append(f"{st.st_mtime_ns}:{st.st_size}")
+    return hashlib.sha1("\n".join(parts).encode()).hexdigest()[:16]
 
 
 def ensure_pip_env(requirements) -> str:
@@ -126,14 +152,21 @@ def ensure_pip_env(requirements) -> str:
     cmd = [sys.executable, "-m", "pip", "install", "--quiet",
            "--no-build-isolation", "--target", tmp,
            *sorted(str(r) for r in requirements)]
-    proc = subprocess.run(cmd, capture_output=True, text=True,
-                          timeout=600)
+    from ray_tpu.exceptions import RuntimeEnvSetupError
+
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600)
+    except subprocess.TimeoutExpired:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise RuntimeEnvSetupError(
+            f"pip install timed out after 600s: {requirements}")
     if proc.returncode != 0:
         import shutil
 
         shutil.rmtree(tmp, ignore_errors=True)
-        from ray_tpu.exceptions import RuntimeEnvSetupError
-
         raise RuntimeEnvSetupError(
             f"pip install failed (rc={proc.returncode}):\n"
             f"{proc.stderr[-2000:]}")

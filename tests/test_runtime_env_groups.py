@@ -80,8 +80,9 @@ def test_invalid_runtime_env_rejected(ray_cluster):
         return 1
 
     f = ray_tpu.remote(noop)
+    # pip became a supported plugin; conda remains unsupported.
     with pytest.raises(ValueError, match="unsupported"):
-        ray_tpu.get(f.options(runtime_env={"pip": ["torch"]}).remote(),
+        ray_tpu.get(f.options(runtime_env={"conda": "env.yml"}).remote(),
                     timeout=60)
 
 

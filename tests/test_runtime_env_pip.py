@@ -85,7 +85,8 @@ def test_pip_env_failure_is_typed(tmp_path):
         def f():
             return 1
 
-        with pytest.raises((RuntimeEnvSetupError, Exception)):
+        with pytest.raises(Exception,
+                           match="RuntimeEnvSetupError|pip install"):
             ray_tpu.get(f.remote(), timeout=300)
     finally:
         ray_tpu.shutdown()
