@@ -146,11 +146,19 @@ class ClusterRuntime:
         self.worker_id = (WorkerID(bytes.fromhex(worker_id))
                           if worker_id and len(worker_id) == 56
                           else WorkerID.from_random())
+        # The id the RAYLET knows this worker by (spawn-time id) — the
+        # blocked/unblocked notifications key on it.
+        self._raylet_worker_id = worker_id or self.worker_id.hex()
+        self._blocked_depth = 0
+        self._blocked_lock = threading.Lock()
         self.node_id = (NodeID(bytes.fromhex(node_id))
                         if node_id else None)
         self._node = node  # owned process supervisor (head driver only)
 
         self._loop = EventLoopThread(name=f"{mode}-rpc")
+        # Must run on the importing (main) thread: signal.signal rejects
+        # non-main threads, and _async_start runs on the loop thread.
+        self._install_task_dumper()
         self._gcs = GcsClient(gcs_address)
         self._raylet = RpcClient(raylet_address)
         self._server = RpcServer(self)
@@ -158,6 +166,14 @@ class ClusterRuntime:
 
         self._shm = WorkerStoreClient()
         self._shm_by_oid: Dict[str, str] = {}  # fetched oid -> segment
+        # Releases queued by ObjectRef finalizers (see deferred_release).
+        from collections import deque as _deque
+
+        self._pending_releases: Any = _deque()
+        self._release_drain_scheduled = False
+        # Every granted task lease, until returned — the lease watchdog
+        # sweeps this for orphans (see _lease_watchdog).
+        self._live_leases: List[dict] = []
         self._owned: Dict[str, _Owned] = {}
         self._owned_lock = threading.Lock()
         # Refs this process BORROWS (owner elsewhere): oid -> [owner
@@ -236,6 +252,8 @@ class ClusterRuntime:
             await self._gcs.subscribe("node", self._on_node_event)
         except Exception:
             logger.warning("node-event subscription failed", exc_info=True)
+        self._lease_watchdog_task = asyncio.ensure_future(
+            self._lease_watchdog())
         if self._log_to_driver:
             # Remote prints/tracebacks stream to this driver's stderr
             # (reference: _private/worker.py:812 print_logs over GCS
@@ -262,6 +280,38 @@ class ClusterRuntime:
             prefix = f"({tag}, pid={entry.get('pid', '?')})"
             for line in entry.get("lines", ()):
                 print(f"{prefix} {line}", file=sys.stderr)
+
+    def _install_task_dumper(self) -> None:
+        """SIGUSR2 prints every asyncio task's stack on the RPC loop —
+        faulthandler (SIGUSR1) shows only THREAD frames, and scheduling
+        wedges live in coroutines (reference affordance: ray stack)."""
+        import signal as _signal
+
+        def _dump() -> None:
+            import sys
+            import traceback
+
+            # sys.__stderr__: bypass pytest/driver capture so the dump
+            # is visible even when the process dies before reporting.
+            err = sys.__stderr__ or sys.stderr
+            tasks = asyncio.all_tasks(self._loop.loop)
+            print(f"=== {len(tasks)} asyncio tasks ===", file=err,
+                  flush=True)
+            for t in tasks:
+                print(f"-- {t.get_coro()}", file=err, flush=True)
+                for frame in t.get_stack(limit=4):
+                    traceback.print_stack(frame, limit=1, file=err)
+
+        def _on_sig(*_a) -> None:
+            try:
+                self._loop.call_soon(_dump)
+            except Exception:
+                pass
+
+        try:
+            _signal.signal(_signal.SIGUSR2, _on_sig)
+        except (ValueError, OSError):
+            pass  # not the main thread / unsupported: debug-only
 
     async def _on_node_event(self, data: dict) -> None:
         if not isinstance(data, dict) or data.get("alive", True):
@@ -461,6 +511,35 @@ class ClusterRuntime:
         with self._borrowed_lock:
             if oid in self._borrowed:
                 self._borrowed[oid][1] += 1
+
+    def deferred_release(self, object_id: ObjectID) -> None:
+        """Lock-free release entry point for ObjectRef.__del__.
+
+        A finalizer can fire at ANY allocation in ANY thread — including
+        while this runtime's own locks are held (observed: GC during
+        handle_get_object_locations, which holds _owned_lock, fired a
+        ref's __del__ whose remove_local_reference re-acquired
+        _owned_lock and self-deadlocked the entire RPC loop). Finalizers
+        therefore only APPEND (GIL-atomic) here; the real release runs
+        on the event loop outside any lock."""
+        self._pending_releases.append(object_id)
+        if not self._release_drain_scheduled:
+            self._release_drain_scheduled = True
+            try:
+                self._loop.call_soon(self._drain_releases)
+            except Exception:
+                pass  # loop stopping at shutdown: releases are moot
+
+    def _drain_releases(self) -> None:
+        self._release_drain_scheduled = False
+        while self._pending_releases:
+            try:
+                self.remove_local_reference(
+                    self._pending_releases.popleft())
+            except IndexError:
+                break
+            except Exception:
+                pass
 
     def remove_local_reference(self, object_id: ObjectID) -> None:
         if self._shutdown:
@@ -684,8 +763,9 @@ class ClusterRuntime:
             # without resolving the old one (the same trap
             # _resolve_dependencies polls around): re-read the entry
             # each slice so a reconstructed object still materializes.
+            wrapped = asyncio.wrap_future(entry.fut)
+            wrapped_fut = entry.fut
             while True:
-                wrapped = asyncio.wrap_future(entry.fut)
                 remaining = (None if deadline is None
                              else max(0.0, deadline - time.monotonic()))
                 slice_t = (0.5 if remaining is None
@@ -700,6 +780,13 @@ class ClusterRuntime:
                     latest = self._owned.get(oid)
                 if latest is not None:
                     entry = latest
+                # Re-wrap ONLY when the underlying future was replaced
+                # (reconstruction): wrapping per slice would chain one
+                # callback + abandoned wrapper onto entry.fut per 0.5s
+                # of waiting, unboundedly.
+                if entry.fut is not wrapped_fut:
+                    wrapped = asyncio.wrap_future(entry.fut)
+                    wrapped_fut = entry.fut
             if kind == "inline":
                 return ("inline", payload, oid)
             # stored on some node; pull through the local raylet
@@ -738,7 +825,58 @@ class ClusterRuntime:
         return self._materialize(
             self._loop.run(self._resolve_async(ref, timeout), timeout=None))
 
+    def _in_executing_task(self) -> bool:
+        return (self.mode == "worker" and threading.get_ident()
+                in self._running_task_threads.values())
+
+    def _notify_block_state(self, blocked: bool) -> None:
+        """Tell our raylet this worker's task is blocked in get() (CPU
+        released for downstream work) / resumed. Reference:
+        NotifyDirectCallTaskBlocked — without it, consumers blocked on
+        not-yet-scheduled producers hold every CPU and the node
+        deadlocks."""
+        method = "worker_blocked" if blocked else "worker_unblocked"
+        try:
+            self._loop.run(self._raylet.notify(
+                method, worker_id=self._raylet_worker_id), timeout=5)
+        except Exception:
+            pass
+
+    def _get_would_wait(self, refs) -> bool:
+        """Cheap pre-check: does this get have a chance of blocking on a
+        not-yet-produced object? Resolved owned refs skip the
+        blocked/unblocked raylet round trips entirely."""
+        ref_list = ([refs] if isinstance(refs, ObjectRef)
+                    else refs if isinstance(refs, (list, tuple)) else None)
+        if ref_list is None:
+            return True
+        for ref in ref_list:
+            if not isinstance(ref, ObjectRef):
+                return True
+            with self._owned_lock:
+                entry = self._owned.get(ref.hex())
+            if entry is None or not entry.fut.done():
+                return True
+        return False
+
     def get(self, refs, timeout: Optional[float] = None):
+        if self._in_executing_task() and self._get_would_wait(refs):
+            with self._blocked_lock:
+                self._blocked_depth += 1
+                fire = self._blocked_depth == 1
+            if fire:
+                self._notify_block_state(True)
+            try:
+                return self._get_inner(refs, timeout)
+            finally:
+                with self._blocked_lock:
+                    self._blocked_depth -= 1
+                    fire = self._blocked_depth == 0
+                if fire:
+                    self._notify_block_state(False)
+        return self._get_inner(refs, timeout)
+
+    def _get_inner(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, (ObjectRef, ObjectRefGenerator))
         if not single and not hasattr(refs, "__iter__"):
             raise TypeError(
@@ -1057,6 +1195,8 @@ class ClusterRuntime:
             worker["worker_address"], False)
         worker["pipeline"] = worker.get("pipeline", 0) + 1
         push_t0 = time.monotonic()
+        worker["push_started"] = push_t0
+        worker["push_task_name"] = spec.get("name")
         try:
             client = await self._worker_client(worker["worker_address"])
             # Pipelining: once the push is on the wire the lease goes
@@ -1078,7 +1218,11 @@ class ClusterRuntime:
                 worker["dead"] = True
                 if not worker.get("returned"):
                     worker["returned"] = True
-                    await self._return_worker(worker, dead=True)
+                    # Fire-and-forget: retrying against a DEAD raylet
+                    # takes tens of seconds; the task's resubmission
+                    # must not stall behind it.
+                    self._loop.spawn(
+                        self._return_worker(worker, dead=True))
                 if await self._worker_was_oom_killed(worker):
                     raise _WorkerOOMKilled(str(push_err)) from push_err
             raise
@@ -1301,6 +1445,10 @@ class ClusterRuntime:
             if reply.get("granted"):
                 info = reply["granted"]
                 info["raylet_address"] = address
+                if not is_actor:
+                    # Actor leases live as long as the actor; only task
+                    # leases are watchdog-swept for orphaning.
+                    self._live_leases.append(info)
                 return info
             if reply.get("spillback"):
                 address = reply["spillback"]
@@ -1308,15 +1456,88 @@ class ClusterRuntime:
                 continue
             raise RpcError(f"lease failed: {reply}")
 
+    async def _lease_watchdog(self) -> None:
+        """Self-healing for leaked leases: any granted lease that is not
+        circulating (not in a pool, no waiter promise), has no in-flight
+        push, and has sat that way for 20s is orphaned — some
+        acquire/offer path lost track of it — and pins raylet resources
+        forever, starving every other scheduling key. Force-return it
+        and log loudly so the underlying leak is visible."""
+        while True:
+            await asyncio.sleep(5.0)
+            now = time.monotonic()
+            for worker in list(self._live_leases):
+                if worker.get("returned"):
+                    try:
+                        self._live_leases.remove(worker)
+                    except ValueError:
+                        pass
+                    continue
+                if worker.get("pipeline", 0) > 0:
+                    # Push(es) in flight: healthy — unless one has been
+                    # outstanding implausibly long; then report the
+                    # connection state so wedges are diagnosable.
+                    started = worker.get("push_started", now)
+                    if now - started > 30.0:
+                        client = (self._worker_clients or {}).get(
+                            worker.get("worker_address"))
+                        logger.warning(
+                            "lease %s: push of %r in flight for %.0fs "
+                            "(worker %s, client_connected=%s, "
+                            "pipeline=%s)",
+                            worker.get("lease_id"),
+                            worker.get("push_task_name"),
+                            now - started, worker.get("worker_address"),
+                            None if client is None else client.connected,
+                            worker.get("pipeline"))
+                    worker.pop("wd_idle_since", None)
+                    continue
+                if worker.get("dead") or worker.get("avail"):
+                    worker.pop("wd_idle_since", None)
+                    continue
+                since = worker.get("wd_idle_since")
+                if since is None:
+                    worker["wd_idle_since"] = now
+                    continue
+                if now - since < 20.0:
+                    continue
+                logger.warning(
+                    "lease %s orphaned for %.0fs (not circulating, no "
+                    "in-flight push); force-returning it",
+                    worker.get("lease_id"), now - since)
+                worker["dead"] = True  # never recirculate
+                worker["returned"] = True
+                try:
+                    self._live_leases.remove(worker)
+                except ValueError:
+                    pass
+                await self._return_worker(worker)
+
     async def _return_worker(self, worker: dict, dead: bool = False) -> None:
-        try:
-            client = await self._raylet_client(worker["raylet_address"])
-            await client.call("return_worker", lease_id=worker["lease_id"],
-                              worker_id=worker["worker_id"],
-                              resources=worker.get("resources", {}),
-                              dead=dead, timeout=5.0)
-        except Exception:
-            pass
+        # A lost return leaks the lease's resources at the raylet FOREVER
+        # (observed: returns timing out against a raylet busy with bulk
+        # object IO starved a whole module's scheduling). Retry with
+        # backoff — handle_return_worker is idempotent — and log loudly
+        # if the lease could not be returned.
+        last: Optional[Exception] = None
+        for attempt in range(4):
+            if attempt:
+                await asyncio.sleep(0.5 * attempt)
+            try:
+                client = await self._raylet_client(
+                    worker["raylet_address"])
+                await client.call("return_worker",
+                                  lease_id=worker["lease_id"],
+                                  worker_id=worker["worker_id"],
+                                  resources=worker.get("resources", {}),
+                                  dead=dead, timeout=10.0)
+                return
+            except Exception as e:  # noqa: BLE001
+                last = e
+        logger.warning("could not return lease %s to %s after retries "
+                       "(%s); its resources may be stranded",
+                       worker.get("lease_id"),
+                       worker.get("raylet_address"), last)
 
     # -- clients -------------------------------------------------------
     async def _raylet_client(self, address: str,

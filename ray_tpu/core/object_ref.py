@@ -67,7 +67,12 @@ class ObjectRef:
         rt = self._runtime
         if rt is not None:
             try:
-                rt.remove_local_reference(self._id)
+                # Finalizers must not take runtime locks (GC can fire
+                # them while those locks are held): prefer the deferred
+                # lock-free release path when the runtime has one.
+                release = getattr(rt, "deferred_release", None) \
+                    or rt.remove_local_reference
+                release(self._id)
             except Exception:
                 pass
 

@@ -45,6 +45,7 @@ class _Worker:
         self.log_path: Optional[str] = None
         self.log_offset = 0  # how far the log monitor has shipped
         self.lease_job_id: Optional[str] = None  # job of the active lease
+        self.blocked = False  # task blocked in get(): CPU released
 
 
 class _Bundle:
@@ -749,7 +750,59 @@ class Raylet:
         alive = sum(1 for w in self._workers.values() if w.state != "dead")
         return alive < limit
 
+    # -- blocked-task CPU release (reference: node_manager.cc
+    # HandleNotifyDirectCallTaskBlocked/Unblocked — a task blocked in
+    # ray.get releases its CPU so downstream tasks can schedule;
+    # without this, N consumers blocked on N producers deadlock a node)
+    def _blocked_cpu_pool(self, w: _Worker) -> Optional[Dict[str, float]]:
+        """Where a blocked worker's CPU goes back to: its PG bundle's
+        available set when leased from one (and the bundle still lives),
+        else the node pool."""
+        if w.bundle_key is not None:
+            b = self._bundles.get(w.bundle_key)
+            if b is None or b.removed:
+                return None
+            return b.available
+        return self.resources_available
+
+    async def handle_worker_blocked(self, conn: ServerConnection, *,
+                                    worker_id: str) -> bool:
+        w = self._workers.get(worker_id)
+        if (w is not None and not w.blocked
+                and w.state in ("leased", "actor")
+                and w.held.get("CPU")):
+            pool = self._blocked_cpu_pool(w)
+            if pool is not None:
+                w.blocked = True
+                pool["CPU"] = pool.get("CPU", 0.0) + w.held["CPU"]
+                self._try_dispatch()
+        return True
+
+    async def handle_worker_unblocked(self, conn: ServerConnection, *,
+                                      worker_id: str) -> bool:
+        w = self._workers.get(worker_id)
+        if w is not None and w.blocked:
+            w.blocked = False
+            pool = self._blocked_cpu_pool(w)
+            if pool is not None:
+                # May transiently oversubscribe (go negative) — new
+                # leases stop until something frees, as the reference.
+                pool["CPU"] = pool.get("CPU", 0.0) - w.held.get("CPU",
+                                                               0.0)
+        return True
+
     def _release_lease_resources(self, worker: _Worker) -> None:
+        if worker.blocked:
+            # The blocked release already returned the CPU to its pool;
+            # re-take it first so the normal release below is exact.
+            worker.blocked = False
+            pool = self._blocked_cpu_pool(worker)
+            if pool is not None:
+                pool["CPU"] = pool.get("CPU", 0.0) - worker.held.get(
+                    "CPU", 0.0)
+        return self._release_lease_resources_inner(worker)
+
+    def _release_lease_resources_inner(self, worker: _Worker) -> None:
         """Return a lease's resources + chips to where they came from: the
         PG bundle if it's still live, else the node pool (a removed bundle's
         in-use share flows back to the pool as its leases end)."""
@@ -1185,6 +1238,11 @@ class Raylet:
             "num_workers": len([w for w in self._workers.values()
                                 if w.state != "dead"]),
             "pending_leases": len(self._pending),
+            "workers": [
+                {"id": w.worker_id[:8], "state": w.state,
+                 "lease_id": w.lease_id, "held": dict(w.held),
+                 "actor": w.actor_id, "alive": w.proc.poll() is None}
+                for w in self._workers.values()],
             "bundles": {k: {"total": b.total, "available": b.available,
                             "committed": b.committed}
                         for k, b in self._bundles.items() if not b.removed},

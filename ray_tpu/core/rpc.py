@@ -368,5 +368,9 @@ class EventLoopThread:
     def spawn(self, coro: Awaitable) -> None:
         asyncio.run_coroutine_threadsafe(coro, self.loop)
 
+    def call_soon(self, fn: Callable[[], Any]) -> None:
+        """Schedule a plain callable on the loop from any thread."""
+        self.loop.call_soon_threadsafe(fn)
+
     def stop(self) -> None:
         self.loop.call_soon_threadsafe(self.loop.stop)

@@ -101,10 +101,28 @@ def prepare_spec_env(rt, runtime_env: Optional[Dict[str, Any]]
 _PIP_ROOT = os.path.join(_EXTRACT_ROOT, "pip")
 
 
+# Key memoization: walking a large source tree per TASK would tax the
+# hot path; a short TTL still catches source edits promptly.
+_pip_key_cache: Dict[tuple, tuple] = {}
+_PIP_KEY_TTL_S = 10.0
+
+
 def pip_env_key(requirements) -> str:
     """Content key: same requirement set -> same cached env. Local
     source/wheel requirements fold in their file stats, so editing the
     package invalidates the cache instead of serving a stale install."""
+    import time as _time
+
+    cache_key = tuple(sorted(str(r) for r in requirements))
+    hit = _pip_key_cache.get(cache_key)
+    if hit is not None and _time.monotonic() - hit[1] < _PIP_KEY_TTL_S:
+        return hit[0]
+    key = _pip_env_key_uncached(requirements)
+    _pip_key_cache[cache_key] = (key, _time.monotonic())
+    return key
+
+
+def _pip_env_key_uncached(requirements) -> str:
     parts = []
     for r in sorted(str(r) for r in requirements):
         parts.append(r)

@@ -46,7 +46,7 @@ def main() -> None:
 
     runtime = ClusterRuntime(
         gcs_address=args.gcs, raylet_address=args.raylet, mode="worker",
-        node_id=args.node_id)
+        node_id=args.node_id, worker_id=args.worker_id)
     set_runtime(runtime)
 
     ok = runtime._loop.run(runtime._raylet.call(

@@ -23,9 +23,14 @@ from ray_tpu.data.executor import StreamingExecutor
 
 class Dataset:
     def __init__(self, read_tasks: List[Callable[[], Block]],
-                 transforms: Optional[List[Callable[[Block], Block]]] = None):
+                 transforms: Optional[List[Callable[[Block], Block]]] = None,
+                 block_refs: Optional[List[Any]] = None):
         self._read_tasks = read_tasks
         self._transforms = list(transforms or [])
+        # Blocks that ALREADY exist as objects (shuffle/sort/groupby
+        # outputs): consumed by direct driver-side gets — no consumer
+        # task, no nested get (reference: Dataset blocks are ObjectRefs).
+        self._block_refs = block_refs
 
     _limit: Optional[int] = None
 
@@ -132,7 +137,8 @@ class Dataset:
             refs = distributed_random_shuffle(
                 self._read_tasks, self._transforms, seed,
                 max(1, len(self._read_tasks)))
-            return Dataset([block_ref_reader(r) for r in refs])
+            return Dataset([block_ref_reader(r) for r in refs],
+                           block_refs=refs)
         block = self.materialize()
         total = block_num_rows(block)
         rng = np.random.default_rng(seed)
@@ -161,7 +167,8 @@ class Dataset:
             refs = distributed_sort(
                 self._read_tasks, self._transforms, key, descending,
                 max(1, len(self._read_tasks)))
-            return Dataset([block_ref_reader(r) for r in refs])
+            return Dataset([block_ref_reader(r) for r in refs],
+                           block_refs=refs)
         block = self.materialize()
         order = np.argsort(np.asarray(block[key]), kind="stable")
         if descending:
@@ -180,12 +187,49 @@ class Dataset:
     def iter_blocks(self, max_in_flight: int = 4) -> Iterator[Block]:
         import ray_tpu
 
-        ex = self._executor(max_in_flight)
-        blocks = (iter(ex) if ray_tpu.is_initialized()
-                  else ex.run_local())
+        if (self._block_refs is not None and not self._transforms
+                and ray_tpu.is_initialized()):
+            blocks = self._iter_block_refs()
+        else:
+            ex = self._executor(max_in_flight)
+            blocks = (iter(ex) if ray_tpu.is_initialized()
+                      else ex.run_local())
         if self._limit is None:
             return blocks
         return self._limited(blocks, self._limit)
+
+    def _iter_block_refs(self) -> Iterator[Block]:
+        import concurrent.futures as _cf
+
+        import ray_tpu
+        from ray_tpu.core.worker import current_runtime
+
+        rt = current_runtime()
+        release = getattr(rt, "_release_shm_mapping", None)
+        refs = list(self._block_refs)
+        if not refs:
+            return
+        # One-ahead prefetch: fetch block i+1 while the consumer works
+        # on block i (the executor path's fetch/compute overlap).
+        pool = _cf.ThreadPoolExecutor(1, thread_name_prefix="ds-prefetch")
+        try:
+            nxt = pool.submit(ray_tpu.get, refs[0], timeout=600)
+            for i, ref in enumerate(refs):
+                block = nxt.result()
+                if i + 1 < len(refs):
+                    nxt = pool.submit(ray_tpu.get, refs[i + 1],
+                                      timeout=600)
+                yield block
+                del block
+                if release is not None:
+                    # Unmap the consumed block's segment now instead of
+                    # at dataset GC — a streaming consumer's RSS stays
+                    # at ~one block. Deferred automatically while the
+                    # consumer still holds zero-copy views; a
+                    # re-iteration simply re-maps.
+                    release(ref.hex())
+        finally:
+            pool.shutdown(wait=False)
 
     @staticmethod
     def _limited(blocks: Iterator[Block], limit: int) -> Iterator[Block]:
@@ -304,7 +348,8 @@ class GroupedData:
             refs = distributed_group_agg(
                 self._ds._read_tasks, self._ds._transforms, self._key,
                 kind, on, fn, max(1, len(self._ds._read_tasks)))
-            out = Dataset([block_ref_reader(r) for r in refs])
+            out = Dataset([block_ref_reader(r) for r in refs],
+                          block_refs=refs)
             if kind == "map_groups":
                 # Output may be data-sized: keep it distributed,
                 # partition order (not key order, like the reference).

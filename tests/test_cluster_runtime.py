@@ -312,3 +312,31 @@ def test_kill_actor_restartable(ray_cluster):
         except ray.exceptions.RayActorError:
             time.sleep(0.2)
     assert second is not None and second != first
+
+
+def test_nested_get_releases_cpu_no_deadlock():
+    """A task blocked in get() must release its CPU so its dependency can
+    schedule (reference: NotifyDirectCallTaskBlocked). On a 1-CPU cluster
+    this deadlocks without the release: outer holds the only CPU while
+    waiting for inner."""
+    import ray_tpu
+
+    # The 1-CPU constraint is the whole test: the module's shared
+    # 4-CPU cluster would pass without exercising the release. This is
+    # the file's last test, so replacing the cluster is safe.
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1)
+    try:
+        assert ray_tpu.cluster_resources().get("CPU") == 1.0
+
+        @ray_tpu.remote
+        def inner():
+            return 21
+
+        @ray_tpu.remote
+        def outer():
+            return ray_tpu.get(inner.remote()) * 2
+
+        assert ray_tpu.get(outer.remote(), timeout=120) == 42
+    finally:
+        ray_tpu.shutdown()
