@@ -42,6 +42,10 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16     # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = False
+    # None = save nothing (recompute the whole layer); "dots" saves
+    # matmul outputs and recomputes only elementwise work — often the
+    # better FLOPs/HBM trade on TPU.
+    remat_policy: Optional[str] = None
     aux_loss_weight: float = 0.01
 
     @property
@@ -113,6 +117,23 @@ def param_specs(cfg: TransformerConfig) -> Params:
     }
 
 
+
+
+_REMAT_POLICIES = {
+    None: None,
+    "dots": "dots_with_no_batch_dims_saveable",
+    "dots_batch": "dots_saveable",
+}
+
+
+def _checkpoint_layer(fn, policy_name):
+    policy = None
+    mapped = _REMAT_POLICIES.get(policy_name, policy_name)
+    if mapped:
+        policy = getattr(jax.checkpoint_policies, mapped)
+    return jax.checkpoint(fn, static_argnums=(2, 3, 4), policy=policy)
+
+
 def _rmsnorm(x, scale, eps=1e-6):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale.astype(x.dtype)
@@ -177,7 +198,7 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     def scan_body(carry, lp):
         fn = _layer
         if cfg.remat:
-            fn = jax.checkpoint(_layer, static_argnums=(2, 3, 4))
+            fn = _checkpoint_layer(_layer, cfg.remat_policy)
         x_new, aux = fn(carry, lp, cfg, mesh, False, cos, sin, positions)
         return x_new, aux
 
@@ -186,7 +207,9 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     # Tied embeddings. Logits stay in the compute dtype (bf16 on TPU): the
     # loss upcasts inside its reductions, so the [B,S,V] float32 array the
     # old code materialized (2 GB at B=16,S=1024,V=32k) never exists.
-    logits = x @ params["embed"].T.astype(act)
+    # einsum instead of `x @ embed.T`: no materialized transpose, XLA
+    # picks the contraction layout.
+    logits = jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(act))
     return logits, jnp.sum(auxes)
 
 
@@ -232,7 +255,7 @@ def forward_pipelined(params: Params, tokens: jax.Array,
         def body(carry, lp):
             fn = _layer
             if cfg.remat:
-                fn = jax.checkpoint(_layer, static_argnums=(2, 3, 4))
+                fn = _checkpoint_layer(_layer, cfg.remat_policy)
             x_new, aux = fn(carry, lp, cfg, mesh, manual_sp, cos, sin, pos)
             return x_new, aux
 
@@ -242,7 +265,7 @@ def forward_pipelined(params: Params, tokens: jax.Array,
     x, aux = gpipe(stage_fn, params["layers"], x, positions, rope, mesh=mesh,
                    num_microbatches=num_microbatches)
     x = _rmsnorm(x, params["ln_f"])
-    logits = x @ params["embed"].T.astype(act)
+    logits = jnp.einsum("bsd,vd->bsv", x, params["embed"].astype(act))
     return logits, aux
 
 
