@@ -118,7 +118,12 @@ class _LeasePool:
     SchedulingKey entries + pipelined lease requests,
     max_pending_lease_requests_per_scheduling_category)."""
 
-    MAX_INFLIGHT = 10
+    @property
+    def MAX_INFLIGHT(self) -> int:
+        from ray_tpu.core.config import ray_config
+
+        return ray_config(
+        ).max_pending_lease_requests_per_scheduling_category
 
     def __init__(self):
         self.idle: List[dict] = []
@@ -1299,7 +1304,7 @@ class ClusterRuntime:
                      resources: Dict[str, float],
                      pg: Optional[dict]) -> None:
         while pool.inflight_leases < min(len(pool.waiters),
-                                         _LeasePool.MAX_INFLIGHT):
+                                         pool.MAX_INFLIGHT):
             pool.inflight_leases += 1
             asyncio.ensure_future(self._fetch_lease(pool, resources, pg))
 
@@ -1330,10 +1335,6 @@ class ClusterRuntime:
         pool.inflight_leases -= 1
         self._hand_worker(pool, worker)
 
-    # Deep pipelining (offering a worker that is still executing) only
-    # pays off when tasks are shorter than a lease round trip; queueing
-    # behind a task slower than this serializes parallelizable work.
-    PIPELINE_SVC_THRESHOLD_S = 0.03
 
     def _offer_worker(self, key: str, worker: dict) -> None:
         """Put a leased worker (back) into circulation if it is alive,
@@ -1348,7 +1349,10 @@ class ClusterRuntime:
             return
         if pipeline > 0:
             ema = worker.get("svc_ema")
-            if ema is None or ema > self.PIPELINE_SVC_THRESHOLD_S:
+            # Deep pipelining (offering a still-executing worker) only
+            # pays off for tasks shorter than a lease round trip.
+            if ema is None or ema > ray_config(
+                    ).pipeline_service_threshold_s:
                 return  # don't queue behind an unknown/slow task
         pool = self._lease_pools.setdefault(key, _LeasePool())
         self._hand_worker(pool, worker)
@@ -1370,7 +1374,7 @@ class ClusterRuntime:
                                   worker: dict) -> None:
         """An idle lease is kept briefly for reuse, then returned so the
         raylet can reschedule its resources."""
-        await asyncio.sleep(0.05)
+        await asyncio.sleep(ray_config().lease_idle_linger_s)
         lingered = 0.0
         while worker in pool.idle and worker.get("pipeline", 0) > 0:
             # Pipelined pushes still executing: the lease cannot be
@@ -2388,7 +2392,9 @@ class ClusterRuntime:
                 return_exceptions=True)
 
         try:
-            results = self._loop.run(_register_all(), timeout=35)
+            results = self._loop.run(
+                _register_all(),
+                timeout=ray_config().borrow_commit_timeout_s)
         except Exception:
             results = [False] * len(pending)
         for (oid, owner, rec), res in zip(pending, results):

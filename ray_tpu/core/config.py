@@ -127,6 +127,24 @@ _D("borrow_escrow_s", 600.0,
    "consumer's register_borrow (reference: reference_count.h borrowing "
    "protocol, here time-bounded).")
 
+_D("object_transfer_chunk_bytes", 1 << 20,
+   "Inter-node object transfer chunk size (reference: ObjectBufferPool "
+   "chunking, object_manager.h).")
+_D("lease_idle_linger_s", 0.05,
+   "How long an idle lease is cached for reuse before returning to the "
+   "raylet (reference: idle lease cache in direct_task_transport).")
+_D("pipeline_service_threshold_s", 0.03,
+   "Deep lease pipelining only engages for workers whose observed "
+   "push->reply time is under this; slower tasks parallelize via fresh "
+   "leases and spillback.")
+_D("log_monitor_interval_s", 0.3,
+   "Worker log tail/publish interval (reference: log_monitor.py).")
+_D("pip_install_timeout_s", 600.0,
+   "Timeout for a runtime-env pip install.")
+_D("borrow_commit_timeout_s", 35.0,
+   "Deadline for registering retained arg borrows with owners at task "
+   "completion (reference: borrowed-refs report in the task reply).")
+
 # -- tensor plane --------------------------------------------------------
 _D("tpu_slice_gang_scheduling", True,
    "Treat a TPU slice as an atomic gang for placement-group scheduling.")

@@ -332,8 +332,9 @@ class Raylet:
         return entries
 
     async def _log_monitor_loop(self) -> None:
+        interval = ray_config().log_monitor_interval_s
         while True:
-            await asyncio.sleep(0.3)
+            await asyncio.sleep(interval)
             try:
                 entries = self._collect_new_log_lines()
                 if entries:
@@ -1049,7 +1050,9 @@ class Raylet:
 
     # Large objects stream in 1 MiB frames so a multi-GB transfer neither
     # doubles peak memory nor monopolizes either event loop.
-    TRANSFER_CHUNK = 1 << 20
+    @property
+    def TRANSFER_CHUNK(self) -> int:
+        return ray_config().object_transfer_chunk_bytes
 
     async def _pull_from_holder(self, remote, oid: str) -> bool:
         """Copy `oid` from a remote raylet into the local store. Returns

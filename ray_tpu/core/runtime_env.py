@@ -172,15 +172,19 @@ def ensure_pip_env(requirements) -> str:
            *sorted(str(r) for r in requirements)]
     from ray_tpu.exceptions import RuntimeEnvSetupError
 
+    from ray_tpu.core.config import ray_config
+
+    timeout_s = ray_config().pip_install_timeout_s
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=600)
+                              timeout=timeout_s)
     except subprocess.TimeoutExpired:
         import shutil
 
         shutil.rmtree(tmp, ignore_errors=True)
         raise RuntimeEnvSetupError(
-            f"pip install timed out after 600s: {requirements}")
+            f"pip install timed out after {timeout_s:.0f}s: "
+            f"{requirements}")
     if proc.returncode != 0:
         import shutil
 
