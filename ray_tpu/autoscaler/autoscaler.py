@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.autoscaler.node_provider import NodeProvider, NodeType
+from ray_tpu.core.config import ray_config
 
 logger = logging.getLogger(__name__)
 
@@ -46,6 +47,7 @@ class StandardAutoscaler:
         self._loop.run(self._gcs.connect())
         self._demand_since: Optional[float] = None
         self._idle_since: Dict[str, float] = {}
+        self._unresolved_since: Dict[str, float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.launched: Dict[str, str] = {}   # node_id -> type name
@@ -191,9 +193,28 @@ class StandardAutoscaler:
                 self._idle_since.pop(node_id, None)
                 continue
             host_ids = hosts_of(node_id) or [node_id]
-            if any(self._node_busy(by_id.get(h)) for h in host_ids):
-                self._idle_since.pop(node_id, None)
-                continue
+            # An unresolvable host mapping (provider can't map the slice
+            # to GCS node ids, or a host hasn't registered yet) reads as
+            # BUSY within a grace window — reaping on missing info would
+            # terminate a live slice whose raylets aren't visible to us
+            # yet. But a node whose hosts STAY unresolvable (crashed VM
+            # that dropped out of the GCS) must still be reclaimed, or it
+            # leaks and pins its max_workers slot forever.
+            infos = [by_id.get(h) for h in host_ids]
+            if any(i is None for i in infos):
+                first = self._unresolved_since.setdefault(
+                    node_id, now)
+                grace = (ray_config().worker_startup_timeout_s
+                         + self.config.idle_timeout_s)
+                if now - first < grace:
+                    self._idle_since.pop(node_id, None)
+                    continue
+                # Beyond grace: fall through as idle (reap path below).
+            else:
+                self._unresolved_since.pop(node_id, None)
+                if any(self._node_busy(i) for i in infos):
+                    self._idle_since.pop(node_id, None)
+                    continue
             first = self._idle_since.setdefault(node_id, now)
             if now - first >= self.config.idle_timeout_s:
                 logger.info("autoscaler terminating idle node %s",
@@ -201,6 +222,7 @@ class StandardAutoscaler:
                 self.provider.terminate_node(node_id)
                 self.launched.pop(node_id, None)
                 self._idle_since.pop(node_id, None)
+                self._unresolved_since.pop(node_id, None)
 
     def shutdown(self) -> None:
         self.stop()

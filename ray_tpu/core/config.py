@@ -149,6 +149,8 @@ _D("borrow_commit_timeout_s", 35.0,
 _D("tpu_slice_gang_scheduling", True,
    "Treat a TPU slice as an atomic gang for placement-group scheduling.")
 _D("collective_timeout_s", 300.0, "Out-of-graph collective op timeout.")
+_D("gcs_wal_compact_bytes", 4 * 1024 * 1024,
+   "GCS write-ahead-log size that triggers snapshot compaction.")
 
 _config = Config()
 

@@ -34,10 +34,12 @@ class GcsServer:
         # observable_store_client): load at boot, snapshot when dirty.
         self._storage_path = storage_path
         self._dirty = False
+        self._dirty_keys: Set[tuple] = set()   # (table, key) pending flush
         self._snapshot_task: Optional[asyncio.Task] = None
         self._flush_lock = asyncio.Lock()
         self._flush_gen = 0
         self._flushed_gen = 0  # last generation SUCCESSFULLY written
+        self._wal_size = 0
         # -- tables (reference: gcs_table_storage.h) ----------------------
         self.nodes: Dict[str, Dict[str, Any]] = {}       # node_id hex -> info
         self.actors: Dict[str, Dict[str, Any]] = {}      # actor_id hex -> info
@@ -75,7 +77,7 @@ class GcsServer:
         if cid is None:
             self.cluster_id = uuid.uuid4().hex
             self.kv["__cluster_id__"] = self.cluster_id.encode()
-            self.mark_dirty()
+            self.mark_dirty("kv", "__cluster_id__")
         else:
             self.cluster_id = (cid.decode() if isinstance(cid, bytes)
                                else str(cid))
@@ -90,12 +92,26 @@ class GcsServer:
         return self.cluster_id
 
     # -- durable storage (reference: gcs_table_storage.h over a store
-    # client; here an atomic pickle snapshot, debounced at 1 Hz) --------
+    # client, redis_store_client.h's per-key writes). Incremental: each
+    # flush appends only the mutated (table, key) records to a write-ahead
+    # log; a full snapshot is written only when the WAL grows past
+    # `gcs_wal_compact_bytes` (compaction), so flush cost is O(delta), not
+    # O(cluster state). --------------------------------------------------
     _PERSISTED_TABLES = ("actors", "named_actors", "jobs",
                          "placement_groups", "kv")
 
-    def mark_dirty(self) -> None:
+    def mark_dirty(self, table: Optional[str] = None,
+                   *keys: str) -> None:
+        """Record mutated rows for the next flush. With no arguments the
+        entire persisted state is marked (recovery/migration path)."""
         self._dirty = True
+        if not self._storage_path:
+            return  # nothing consumes the key set; don't grow it unbounded
+        if table is None:
+            for t in self._PERSISTED_TABLES:
+                self._dirty_keys.update((t, k) for k in getattr(self, t))
+        else:
+            self._dirty_keys.update((table, k) for k in keys)
 
     async def flush_now(self) -> None:
         """Write-through for registration-class mutations (named actors,
@@ -105,52 +121,114 @@ class GcsServer:
         actor state transitions) stay on the 1 Hz debounce."""
         if not self._storage_path:
             return
+        import pickle
+        import struct
+
         my_gen = self._flush_gen
         async with self._flush_lock:
             if self._flushed_gen > my_gen:
-                # A snapshot that STARTED after this caller's mutation
-                # (and after it queued here) captured it AND hit disk:
-                # coalesce instead of rewriting full state once per acked
-                # KV put. Comparing against the successfully-WRITTEN
-                # generation matters — coalescing on a failed overlapping
-                # write would ack a mutation that never persisted.
+                # A flush that STARTED after this caller's mutation (and
+                # after it queued here) captured it AND hit disk: coalesce
+                # instead of writing once per acked KV put. Comparing
+                # against the successfully-WRITTEN generation matters —
+                # coalescing on a failed overlapping write would ack a
+                # mutation that never persisted.
                 return
             gen = self._flush_gen = self._flush_gen + 1
             self._dirty = False
-            # Copy on the event loop (two levels: table + record): the
-            # writer thread otherwise pickles live dicts that handlers
-            # keep mutating ("dict changed size during iteration").
-            snap = {t: {k: (dict(v) if isinstance(v, dict) else v)
-                        for k, v in getattr(self, t).items()}
-                    for t in self._PERSISTED_TABLES}
+            keys = self._dirty_keys
+            self._dirty_keys = set()
+            if not keys:
+                self._flushed_gen = gen
+                return
+            # Serialize ON the event loop: handlers can't mutate records
+            # while we pickle, so no deep copy is needed and the writer
+            # thread only ever touches immutable bytes.
+            records = []
+            for table, key in keys:
+                tbl = getattr(self, table)
+                records.append((table, key, key in tbl, tbl.get(key)))
+            payload = pickle.dumps(records, protocol=5)
+            frame = struct.pack("<I", len(payload)) + payload
             try:
-                await asyncio.to_thread(self._write_snapshot, snap)
+                await asyncio.to_thread(self._append_wal, frame)
                 self._flushed_gen = gen
             except Exception:
+                self._dirty_keys |= keys
                 self._dirty = True  # snapshot loop retries
                 logger.warning("GCS write-through failed", exc_info=True)
                 # Callers ack durability to their clients — a failed
                 # write must surface as a failed mutation, not a silent
                 # success that a crash then forgets.
                 raise
+            if self._wal_size >= ray_config().gcs_wal_compact_bytes:
+                await self._compact()
+
+    _SNAP_MAGIC = b"GSNP1\x00"
+
+    async def _compact(self) -> None:
+        """Fold the WAL into a fresh full snapshot. Caller holds
+        _flush_lock, so no deltas append concurrently. Records are pickled
+        on the loop in small batches with a yield between them, so the loop
+        never stalls for the whole state (heartbeats keep flowing); a
+        record mutated after its batch was serialized is in _dirty_keys
+        and its delta lands in the (empty) WAL right after compaction.
+        Crash between the snapshot rename and the WAL truncate is safe:
+        replaying the stale WAL re-applies values the snapshot already
+        contains."""
+        import pickle
+        import struct
+
+        frames = [self._SNAP_MAGIC]
+        for t in self._PERSISTED_TABLES:
+            tbl = getattr(self, t)
+            keys = list(tbl)
+            for i in range(0, len(keys), 500):
+                batch = [(t, k, True, tbl[k]) for k in keys[i:i + 500]
+                         if k in tbl]
+                payload = pickle.dumps(batch, protocol=5)
+                frames.append(struct.pack("<I", len(payload)) + payload)
+                await asyncio.sleep(0)
+        blob = b"".join(frames)
+        try:
+            await asyncio.to_thread(self._write_snapshot_and_truncate, blob)
+        except Exception:
+            logger.warning("GCS compaction failed (WAL keeps growing)",
+                           exc_info=True)
 
     def _load_storage(self) -> None:
         if not self._storage_path:
             return
         import os
         import pickle
+        import struct
 
-        if not os.path.exists(self._storage_path):
-            return
-        try:
-            with open(self._storage_path, "rb") as f:
-                snap = pickle.load(f)
-        except Exception:
-            logger.warning("GCS storage at %s unreadable; starting fresh",
-                           self._storage_path, exc_info=True)
-            return
-        for table in self._PERSISTED_TABLES:
-            getattr(self, table).update(snap.get(table, {}))
+        if os.path.exists(self._storage_path):
+            try:
+                with open(self._storage_path, "rb") as f:
+                    head = f.read(len(self._SNAP_MAGIC))
+                    if head == self._SNAP_MAGIC:
+                        # Framed snapshot (same record format as the WAL).
+                        self._replay_frames(f, torn_ok=False)
+                    else:
+                        # Legacy single-pickle snapshot.
+                        f.seek(0)
+                        snap = pickle.load(f)
+                        for table in self._PERSISTED_TABLES:
+                            getattr(self, table).update(snap.get(table, {}))
+            except Exception:
+                logger.warning(
+                    "GCS snapshot at %s unreadable; starting from WAL only",
+                    self._storage_path, exc_info=True)
+        # Replay the delta log over the snapshot. A torn tail (crash mid
+        # append) ends the replay at the last complete frame.
+        wal = self._wal_path()
+        if os.path.exists(wal):
+            with open(wal, "rb") as f:
+                replayed = self._replay_frames(f, torn_ok=True)
+            self._wal_size = os.path.getsize(wal)
+            if replayed:
+                logger.info("GCS replayed %d WAL batches", replayed)
         # Recovered actor records point at pre-restart workers; their
         # liveness is re-established by owners / health checks. Nodes are
         # NOT persisted — raylets re-register via heartbeat.
@@ -165,15 +243,65 @@ class GcsServer:
                 continue
             # flush_now serializes every writer through _flush_lock —
             # an unsynchronized periodic write could capture older tables
-            # yet rename over a newer write-through snapshot.
+            # yet land over a newer write-through.
             try:
                 await self.flush_now()
             except Exception:
                 pass  # stays dirty; retried next tick
 
-    def _write_snapshot(self, snap: dict) -> None:
-        import os
+    def _replay_frames(self, f, torn_ok: bool) -> int:
+        """Apply length-prefixed record batches from an open file. A torn
+        tail (crash mid-append) ends a WAL replay at the last complete
+        frame; in a snapshot it means corruption, so raise."""
         import pickle
+        import struct
+
+        replayed = 0
+        while True:
+            hdr = f.read(4)
+            if not hdr:
+                break
+            if len(hdr) < 4:
+                if torn_ok:
+                    break
+                raise EOFError("truncated snapshot frame header")
+            (n,) = struct.unpack("<I", hdr)
+            payload = f.read(n)
+            if len(payload) < n:
+                if torn_ok:
+                    break
+                raise EOFError("truncated snapshot frame")
+            try:
+                records = pickle.loads(payload)
+            except Exception:
+                if torn_ok:
+                    break
+                raise
+            for table, key, present, value in records:
+                tbl = getattr(self, table, None)
+                if tbl is None:
+                    continue
+                if present:
+                    tbl[key] = value
+                else:
+                    tbl.pop(key, None)
+            replayed += 1
+        return replayed
+
+    def _wal_path(self) -> str:
+        return f"{self._storage_path}.wal"
+
+    def _append_wal(self, frame: bytes) -> None:
+        import os
+
+        with open(self._wal_path(), "ab") as f:
+            f.write(frame)
+            f.flush()
+            os.fsync(f.fileno())
+            self._wal_size = f.tell()
+
+    def _write_snapshot_and_truncate(self, blob: bytes) -> None:
+        import os
         import threading
 
         # Unique tmp per writer: stop()'s final flush may overlap an
@@ -181,10 +309,14 @@ class GcsServer:
         tmp = (f"{self._storage_path}.tmp.{os.getpid()}"
                f".{threading.get_ident()}")
         with open(tmp, "wb") as f:
-            pickle.dump(snap, f)
+            f.write(blob)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._storage_path)
+        with open(self._wal_path(), "wb") as f:
+            f.flush()
+            os.fsync(f.fileno())
+        self._wal_size = 0
 
     async def stop(self) -> None:
         if self._health_task:
@@ -333,7 +465,6 @@ class GcsServer:
     async def handle_register_actor(self, conn: ServerConnection, *,
                                     actor_id: str, info: Dict[str, Any]
                                     ) -> Dict[str, Any]:
-        self.mark_dirty()
         name = info.get("name")
         ns = info.get("namespace") or "default"
         if name:
@@ -348,6 +479,8 @@ class GcsServer:
                             "error": f"actor name '{name}' already taken in "
                                      f"namespace '{ns}'"}
             self.named_actors[key] = actor_id
+            self.mark_dirty("named_actors", key)
+        self.mark_dirty("actors", actor_id)
         info = dict(info, actor_id=actor_id, state=info.get("state",
                                                             "PENDING"))
         self.actors[actor_id] = info
@@ -362,11 +495,11 @@ class GcsServer:
     async def handle_update_actor(self, conn: ServerConnection, *,
                                   actor_id: str,
                                   updates: Dict[str, Any]) -> bool:
-        self.mark_dirty()
         info = self.actors.get(actor_id)
         if info is None:
             return False
         info.update(updates)
+        self.mark_dirty("actors", actor_id)
         await self._publish(f"actor:{actor_id}", info)
         if info.get("state") == "DEAD":
             name = info.get("name")
@@ -380,6 +513,7 @@ class GcsServer:
             if (name and not restartable
                     and self.named_actors.get(f"{ns}/{name}") == actor_id):
                 del self.named_actors[f"{ns}/{name}"]
+                self.mark_dirty("named_actors", f"{ns}/{name}")
         return True
 
     async def handle_get_actor(self, conn: ServerConnection, *,
@@ -402,9 +536,9 @@ class GcsServer:
     # ------------------------------------------------------------------
     async def handle_add_job(self, conn: ServerConnection, *, job_id: str,
                              info: Dict[str, Any]) -> bool:
-        self.mark_dirty()
         self.jobs[job_id] = dict(info, job_id=job_id,
                                  start_time=time.time())
+        self.mark_dirty("jobs", job_id)
         return True
 
     async def handle_get_job(self, conn: ServerConnection, *,
@@ -413,10 +547,10 @@ class GcsServer:
 
     async def handle_mark_job_finished(self, conn: ServerConnection, *,
                                        job_id: str) -> bool:
-        self.mark_dirty()
         if job_id in self.jobs:
             self.jobs[job_id]["finished"] = True
             self.jobs[job_id]["end_time"] = time.time()
+            self.mark_dirty("jobs", job_id)
         # Non-detached actors die with their job (reference:
         # GcsActorManager::OnJobFinished); raylets subscribe and reap
         # their local actor workers. Detached actors survive.
@@ -426,6 +560,7 @@ class GcsServer:
                     and info.get("state") not in ("DEAD",)):
                 info["state"] = "DEAD"
                 info["death_cause"] = "job finished"
+                self.mark_dirty("actors", actor_id)
                 await self._publish(f"actor:{actor_id}", info)
         await self._publish("job", {"job_id": job_id, "finished": True})
         return True
@@ -460,8 +595,8 @@ class GcsServer:
             # Equal value => treat as an at-least-once retry of the put
             # that already won (the client may never have seen the ack).
             return self.kv[k] == value
-        self.mark_dirty()
         self.kv[k] = value
+        self.mark_dirty("kv", k)
         await self.flush_now()  # KV acks are durable (Serve state, etc.)
         return True
 
@@ -472,9 +607,9 @@ class GcsServer:
 
     async def handle_kv_del(self, conn: ServerConnection, *,
                             key: bytes) -> bool:
-        self.mark_dirty()
         k = key.decode() if isinstance(key, bytes) else key
         existed = self.kv.pop(k, None) is not None
+        self.mark_dirty("kv", k)
         await self.flush_now()
         return existed
 
@@ -493,15 +628,14 @@ class GcsServer:
     async def handle_register_placement_group(
             self, conn: ServerConnection, *, pg_id: str,
             info: Dict[str, Any]) -> bool:
-        self.mark_dirty()
         self.placement_groups[pg_id] = dict(info, pg_id=pg_id)
+        self.mark_dirty("placement_groups", pg_id)
         return True
 
     async def handle_update_placement_group(
             self, conn: ServerConnection, *, pg_id: str,
             updates: Dict[str, Any],
             expect_state: Optional[str] = None) -> bool:
-        self.mark_dirty()
         """`expect_state` makes the update conditional (CAS): the async
         owner-side scheduler must not resurrect a REMOVED group."""
         info = self.placement_groups.get(pg_id)
@@ -510,6 +644,7 @@ class GcsServer:
         if expect_state is not None and info.get("state") != expect_state:
             return False
         info.update(updates)
+        self.mark_dirty("placement_groups", pg_id)
         await self._publish(f"pg:{pg_id}", info)
         return True
 

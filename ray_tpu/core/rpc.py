@@ -52,15 +52,18 @@ class ConnectionLost(RpcError):
 
 class _BatchedWriter:
     """Coalesces frames queued within one event-loop tick into a single
-    transport write.
+    transport write — without taxing lone frames.
 
     On virtualized hosts a socket send costs 0.1-1 ms of syscall time, so
     per-frame writes dominate the task hot loop (measured: ~0.8 ms/write
-    on the dev box, 1 write per push_task). Frames appended on the loop
-    between two ticks go out in one send; ordering is append order since
-    every sender runs on the loop thread."""
+    on the dev box, 1 write per push_task). The first frame of a loop tick
+    is written immediately (a sequential request/reply exchange never waits
+    for the next tick — deferring every frame cost ~0.2 ms of round-trip
+    p50); frames that follow within the same tick buffer and go out in one
+    coalesced send at tick end. Ordering holds because every sender runs on
+    the loop thread and the buffer drains before newer immediate writes."""
 
-    __slots__ = ("_writer", "_loop", "_buf", "_scheduled",
+    __slots__ = ("_writer", "_loop", "_buf", "_scheduled", "_hot",
                  "on_write_error")
 
     # Above this much unflushed transport buffer, senders pause on drain
@@ -73,13 +76,25 @@ class _BatchedWriter:
         self._loop = loop
         self._buf: list = []
         self._scheduled = False
+        self._hot = False          # a write already happened this tick
         self.on_write_error = None
 
     def send(self, frame: bytes) -> None:
+        if not self._hot and not self._buf:
+            # First frame this tick: write now, mark the tick hot so a
+            # burst that follows coalesces instead of paying one syscall
+            # per frame.
+            self._hot = True
+            self._loop.call_soon(self._cool)
+            self._write(frame)
+            return
         self._buf.append(frame)
         if not self._scheduled:
             self._scheduled = True
             self._loop.call_soon(self.flush)
+
+    def _cool(self) -> None:
+        self._hot = False
 
     def flush(self) -> None:
         self._scheduled = False
@@ -87,6 +102,9 @@ class _BatchedWriter:
             return
         data = self._buf[0] if len(self._buf) == 1 else b"".join(self._buf)
         self._buf.clear()
+        self._write(data)
+
+    def _write(self, data: bytes) -> None:
         try:
             if (self._writer.transport is not None
                     and self._writer.transport.is_closing()):
@@ -372,5 +390,22 @@ class EventLoopThread:
         """Schedule a plain callable on the loop from any thread."""
         self.loop.call_soon_threadsafe(fn)
 
-    def stop(self) -> None:
+    def stop(self, drain_timeout: float = 2.0) -> None:
+        """Cancel every task still pending on the loop and let it unwind
+        before stopping — otherwise asyncio logs "Task was destroyed but it
+        is pending" for each orphaned background coroutine (lease fetches,
+        idle-linger timers) on interpreter exit."""
+
+        async def _drain():
+            tasks = [t for t in asyncio.all_tasks()
+                     if t is not asyncio.current_task()]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        try:
+            fut = asyncio.run_coroutine_threadsafe(_drain(), self.loop)
+            fut.result(drain_timeout)
+        except Exception:
+            pass
         self.loop.call_soon_threadsafe(self.loop.stop)
