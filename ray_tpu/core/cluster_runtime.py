@@ -35,6 +35,9 @@ from ray_tpu.core.ids import (ActorID, JobID, NodeID, ObjectID, TaskID,
                               WorkerID, _Counter)
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.object_store import WorkerStoreClient, _WriteIntoShm
+from ray_tpu.core.wire import (ActorTaskSpec as WireActorTaskSpec,
+                               LeaseRequest as WireLeaseRequest,
+                               TaskSpec as WireTaskSpec, from_wire, to_wire)
 from ray_tpu.core.rpc import (ConnectionLost, EventLoopThread, RpcClient,
                               RpcError, RpcServer, ServerConnection)
 from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
@@ -983,37 +986,37 @@ class ClusterRuntime:
         streaming = opts.num_returns in ("streaming", "dynamic")
         num_returns = 1 if streaming else opts.num_returns
         args_blob, pinned = self._serialize_args(args, kwargs)
-        spec = {
-            "task_id": task_id.hex(),
-            "job_id": self.job_id.hex(),
-            "fn_key": fn_key,
-            "name": remote_function._function_name,
-            "args": args_blob,
+        env = _prepared_env(self, opts)
+        pg = _pg_id_of(getattr(opts, "placement_group", None))
+        # Typed wire message (core/wire.py TaskSpec): field presence and
+        # types are enforced at construction AND on the receiver's decode.
+        spec = WireTaskSpec(
+            task_id=task_id.hex(),
+            job_id=self.job_id.hex(),
+            fn_key=fn_key,
+            name=remote_function._function_name,
+            args=args_blob,
             # TOP-LEVEL arg refs only, for pre-lease dependency
             # resolution (reference: dependency_resolver.h — deps resolve
             # BEFORE a worker is leased, so a blocked dependency never
             # holds a worker slot hostage). Nested refs (inside
             # lists/dicts) are pass-by-reference — the worker never
             # fetches them, so submission must not block on them.
-            "arg_oids": [a.hex() for a in
-                         list(args) + list(kwargs.values())
-                         if isinstance(a, ObjectRef)],
-            "num_returns": num_returns,
-            "streaming": streaming,
-            "owner": self.address,
-            "resources": resource_demand(opts),
-            "max_retries": opts.max_retries,
-        }
-        env = _prepared_env(self, opts)
-        if env:
-            spec["runtime_env"] = env
-        pg = _pg_id_of(getattr(opts, "placement_group", None))
-        if pg is not None:
-            spec["pg"] = {
+            arg_oids=[a.hex() for a in
+                      list(args) + list(kwargs.values())
+                      if isinstance(a, ObjectRef)],
+            num_returns=num_returns,
+            streaming=streaming,
+            owner=self.address,
+            resources=resource_demand(opts),
+            max_retries=opts.max_retries,
+            runtime_env=env or None,
+            pg=(None if pg is None else {
                 "pg_id": pg,
                 "bundle_index": getattr(
                     opts, "placement_group_bundle_index", -1),
-            }
+            }),
+        )
         refs = self._make_return_refs(task_id, num_returns)
         gen = None
         if streaming:
@@ -1195,7 +1198,9 @@ class ClusterRuntime:
             self._offer_worker(key, worker)
             raise _TaskCancelledBeforePush()
         if worker.get("chip_ids"):
-            spec = dict(spec, visible_chips=worker["chip_ids"])
+            spec = (spec.replace(visible_chips=worker["chip_ids"])
+                    if hasattr(spec, "replace")
+                    else dict(spec, visible_chips=worker["chip_ids"]))
         self._inflight_task_workers[spec["task_id"]] = (
             worker["worker_address"], False)
         worker["pipeline"] = worker.get("pipeline", 0) + 1
@@ -1212,7 +1217,10 @@ class ClusterRuntime:
             # time — queueing behind a LONG task would serialize work
             # that fresh leases (and spillback) could run in parallel.
             self._offer_worker(key, worker)
-            reply = await client.call("push_task", spec=spec, timeout=None)
+            reply = await client.call(
+                "push_task",
+                spec=to_wire(spec) if hasattr(spec, "_wire_name") else spec,
+                timeout=None)
         except BaseException as push_err:
             # BaseException on purpose: a CancelledError that skipped the
             # decrement would wedge the lease at pipeline>0 forever — the
@@ -1430,11 +1438,13 @@ class ClusterRuntime:
                 continue
             try:
                 reply = await client.call(
-                    "request_worker_lease", resources=resources,
-                    is_actor=is_actor, spillback_count=spillbacks,
-                    bundle=list(bundle) if bundle else None,
-                    request_id=request_id,
-                    job_id=self.job_id.hex(),
+                    "request_worker_lease",
+                    req=to_wire(WireLeaseRequest(
+                        resources=resources, is_actor=is_actor,
+                        spillback_count=spillbacks,
+                        bundle=list(bundle) if bundle else None,
+                        request_id=request_id,
+                        job_id=self.job_id.hex())),
                     timeout=ray_config().worker_lease_timeout_ms / 1000.0)
             except (TimeoutError, asyncio.TimeoutError):
                 # Tell the raylet we gave up: drop the queued request, or
@@ -1724,20 +1734,20 @@ class ClusterRuntime:
         with self._actor_seq_lock:
             seq = self._actor_call_seq.get(aid, 0)
             self._actor_call_seq[aid] = seq + 1
-        spec = {
-            "task_id": task_id.hex(),
-            "job_id": self.job_id.hex(),
-            "actor_id": aid,
-            "method": method_name,
-            "name": f"{handle._class_name}.{method_name}",
-            "args": args_blob,
-            "num_returns": num_returns,
-            "streaming": streaming,
-            "owner": self.address,
-            "seq": seq,
-            "concurrency_group": (handle._method_meta or {}).get(
+        spec = WireActorTaskSpec(
+            task_id=task_id.hex(),
+            job_id=self.job_id.hex(),
+            actor_id=aid,
+            method=method_name,
+            name=f"{handle._class_name}.{method_name}",
+            args=args_blob,
+            num_returns=num_returns,
+            streaming=streaming,
+            owner=self.address,
+            seq=seq,
+            concurrency_group=(handle._method_meta or {}).get(
                 method_name, {}).get("concurrency_group"),
-        }
+        )
         refs = self._make_return_refs(task_id, num_returns)
         self._record_task_event(task_id.hex(), spec["name"], "SUBMITTED",
                                 actor_id=aid)
@@ -1802,8 +1812,10 @@ class ClusterRuntime:
             if state is not None and state.address:
                 self._inflight_task_workers[spec["task_id"]] = (
                     state.address, True)
-            reply = await client.call("push_actor_task", spec=spec,
-                                      timeout=None)
+            reply = await client.call(
+                "push_actor_task",
+                spec=to_wire(spec) if hasattr(spec, "_wire_name") else spec,
+                timeout=None)
             self._record_task_reply(spec, reply)
         except RayActorError as e:
             self._fail_actor_task(spec, refs, e)
@@ -2563,7 +2575,11 @@ class ClusterRuntime:
 
     async def handle_push_task(self, conn: ServerConnection, *,
                                spec: dict) -> dict:
-
+        if isinstance(spec, dict) and "_t" in spec:
+            # Typed decode boundary: a malformed spec dies HERE with a
+            # WireDecodeError naming the field, not as a KeyError inside
+            # the executor.
+            spec = from_wire(spec, expect="TaskSpec")
         # Refuse work the moment our raylet is gone (don't wait to fail
         # on the result store): the pusher holds a stale lease on a dead
         # node; exiting here converts it to a clean worker-death retry
@@ -2768,6 +2784,8 @@ class ClusterRuntime:
 
     async def handle_push_actor_task(self, conn: ServerConnection, *,
                                      spec: dict) -> dict:
+        if isinstance(spec, dict) and "_t" in spec:
+            spec = from_wire(spec, expect="ActorTaskSpec")
         if self._actor_instance is None:
             raise RpcError("no actor instance on this worker")
         if spec.get("streaming"):

@@ -129,7 +129,32 @@ class GcsClient:
     # -- pubsub ---------------------------------------------------------
     async def subscribe(self, channel: str,
                         handler: Callable[[Any], Any]) -> None:
-        self.rpc.on_push(channel, handler)
+        # Deliveries arrive as typed PubsubMessage envelopes
+        # (core/wire.py); unwrap HERE so channel handlers receive the
+        # plain payload. A malformed delivery raises WireDecodeError
+        # into the push dispatcher's log instead of corrupting handlers.
+        # The per-channel seq detects dropped deliveries (a seq that
+        # moves backwards is a GCS restart: counters reset, not a drop).
+        last_seq = [0]
+
+        def unwrap(payload):
+            if isinstance(payload, dict) and payload.get(
+                    "_t") == "PubsubMessage":
+                from ray_tpu.core.wire import from_wire
+
+                msg = from_wire(payload, expect="PubsubMessage")
+                if msg.seq is not None:
+                    if last_seq[0] and msg.seq > last_seq[0] + 1:
+                        logger.warning(
+                            "pubsub channel %r: %d deliveries lost "
+                            "(seq %d -> %d)", channel,
+                            msg.seq - last_seq[0] - 1, last_seq[0],
+                            msg.seq)
+                    last_seq[0] = msg.seq
+                payload = msg.data
+            return handler(payload)
+
+        self.rpc.on_push(channel, unwrap)
         await self.rpc.call("subscribe", channel=channel)
         self.rpc.mark_subscribed(channel)
 
@@ -138,7 +163,10 @@ class GcsClient:
 
     # -- nodes ----------------------------------------------------------
     async def register_node(self, **kwargs: Any) -> Dict[str, Any]:
-        return await self.rpc.call("register_node", **kwargs)
+        from ray_tpu.core.wire import NodeInfo, to_wire
+
+        return await self.rpc.call("register_node",
+                                   node=to_wire(NodeInfo(**kwargs)))
 
     async def heartbeat(self, node_id: str,
                         resources_available: Dict[str, float],
@@ -159,8 +187,17 @@ class GcsClient:
     # -- actors ---------------------------------------------------------
     async def register_actor(self, actor_id: str,
                              info: Dict[str, Any]) -> Dict[str, Any]:
+        # Typed wire envelope (core/wire.py ActorInfo): registration is
+        # the durable record — validate it at the schema boundary.
+        from ray_tpu.core.wire import ActorInfo, to_wire
+
+        if isinstance(info, dict):
+            info = ActorInfo(actor_id=actor_id,
+                             state=info.get("state", "PENDING"),
+                             **{k: v for k, v in info.items()
+                                if k != "state"})
         return await self.rpc.call("register_actor", actor_id=actor_id,
-                                   info=info)
+                                   info=to_wire(info))
 
     async def update_actor(self, actor_id: str,
                            updates: Dict[str, Any]) -> bool:
@@ -179,7 +216,11 @@ class GcsClient:
 
     # -- jobs -----------------------------------------------------------
     async def add_job(self, job_id: str, info: Dict[str, Any]) -> None:
-        await self.rpc.call("add_job", job_id=job_id, info=info)
+        from ray_tpu.core.wire import JobInfo, to_wire
+
+        if isinstance(info, dict):
+            info = JobInfo(job_id=job_id, **info)
+        await self.rpc.call("add_job", job_id=job_id, info=to_wire(info))
 
     async def get_job(self, job_id: str) -> Optional[Dict[str, Any]]:
         return await self.rpc.call("get_job", job_id=job_id)

@@ -55,6 +55,7 @@ class GcsServer:
         self.task_events: deque = deque(maxlen=100_000)
         # -- pubsub (reference: InternalPubSub / pubsub/) -----------------
         self._subs: Dict[str, Set[ServerConnection]] = {}
+        self._pub_seq: Dict[str, int] = {}
         self._heartbeats: Dict[str, float] = {}
         self._health_task: Optional[asyncio.Task] = None
         self._start_time = time.time()
@@ -372,11 +373,18 @@ class GcsServer:
     # pubsub
     # ------------------------------------------------------------------
     async def _publish(self, channel: str, data: Any) -> None:
+        # Typed pubsub envelope (core/wire.py PubsubMessage): per-channel
+        # delivery sequence numbers let subscribers detect drops; the
+        # client unwraps centrally so channel handlers see plain data.
+        from ray_tpu.core.wire import PubsubMessage, to_wire
+
+        seq = self._pub_seq[channel] = self._pub_seq.get(channel, 0) + 1
+        frame = to_wire(PubsubMessage(channel=channel, data=data, seq=seq))
         for conn in list(self._subs.get(channel, ())):
             if conn.closed:
                 self._subs[channel].discard(conn)
             else:
-                await conn.push(channel, data)
+                await conn.push(channel, frame)
 
     async def handle_subscribe(self, conn: ServerConnection, *,
                                channel: str) -> bool:
@@ -408,11 +416,23 @@ class GcsServer:
     # nodes (reference: GcsNodeManager + NodeInfoGcsService)
     # ------------------------------------------------------------------
     async def handle_register_node(self, conn: ServerConnection, *,
-                                   node_id: str, address: str,
-                                   object_store_address: str,
-                                   resources: Dict[str, float],
-                                   labels: Dict[str, str],
+                                   node: Optional[dict] = None,
+                                   node_id: str = "", address: str = "",
+                                   object_store_address: str = "",
+                                   resources: Optional[Dict[str, float]]
+                                   = None,
+                                   labels: Optional[Dict[str, str]] = None,
                                    is_head: bool = False) -> Dict[str, Any]:
+        if node is not None:
+            from ray_tpu.core.wire import from_wire
+
+            n = from_wire(node, expect="NodeInfo")
+            node_id, address = n.node_id, n.address
+            object_store_address = n.object_store_address or address
+            resources, labels = n.resources, n.labels
+            is_head = n.is_head
+        resources = resources or {}
+        labels = labels or {}
         # A node re-registering after WE declared it dead must be told:
         # the cluster already restarted its actors and reconstructed its
         # objects elsewhere, so its surviving actor workers are stale.
@@ -465,6 +485,13 @@ class GcsServer:
     async def handle_register_actor(self, conn: ServerConnection, *,
                                     actor_id: str, info: Dict[str, Any]
                                     ) -> Dict[str, Any]:
+        if isinstance(info, dict) and "_t" in info:
+            # Typed decode (core/wire.py ActorInfo): malformed peers fail
+            # here with a WireDecodeError naming the bad field; the table
+            # stores the validated plain record.
+            from ray_tpu.core.wire import from_wire
+
+            info = from_wire(info, expect="ActorInfo").as_dict()
         name = info.get("name")
         ns = info.get("namespace") or "default"
         if name:
@@ -536,6 +563,10 @@ class GcsServer:
     # ------------------------------------------------------------------
     async def handle_add_job(self, conn: ServerConnection, *, job_id: str,
                              info: Dict[str, Any]) -> bool:
+        if isinstance(info, dict) and "_t" in info:
+            from ray_tpu.core.wire import from_wire
+
+            info = from_wire(info, expect="JobInfo").as_dict()
         self.jobs[job_id] = dict(info, job_id=job_id,
                                  start_time=time.time())
         self.mark_dirty("jobs", job_id)

@@ -510,12 +510,24 @@ class Raylet:
         return best
 
     async def handle_request_worker_lease(
-            self, conn: ServerConnection, *, resources: Dict[str, float],
+            self, conn: ServerConnection, *,
+            req: Optional[dict] = None,
+            resources: Optional[Dict[str, float]] = None,
             scheduling_key: str = "", is_actor: bool = False,
             spillback_count: int = 0,
             bundle: Optional[List[Any]] = None,
             request_id: Optional[str] = None,
             job_id: Optional[str] = None) -> Dict[str, Any]:
+        if req is not None:
+            # Typed wire path (core/wire.py LeaseRequest) — validated
+            # decode; the flat-kwarg form stays for in-process callers.
+            from ray_tpu.core.wire import from_wire
+
+            lr = from_wire(req, expect="LeaseRequest")
+            resources, scheduling_key = lr.resources, lr.scheduling_key
+            is_actor, spillback_count = lr.is_actor, lr.spillback_count
+            bundle, request_id = lr.bundle, lr.request_id
+            job_id = lr.job_id
         demand = {k: float(v) for k, v in resources.items() if v}
         if logger.isEnabledFor(logging.DEBUG):
             logger.debug(

@@ -172,11 +172,18 @@ class RpcServer:
                     logger.exception("disconnect handler failed")
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
+        # Close live connections BEFORE waiting on the listener: since
+        # 3.12 `Server.wait_closed()` also waits for connection handlers,
+        # so a handler blocked in read_frame would hang the stop forever.
+        # The wait stays bounded as a backstop (gh-120866 class hangs).
         for conn in list(self._conns.values()):
             conn.close()
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5.0)
+            except (asyncio.TimeoutError, TimeoutError):
+                pass
 
 
 class ServerConnection:
@@ -208,6 +215,14 @@ class ServerConnection:
 
     async def _dispatch(self, msg: Dict[str, Any]) -> None:
         req_id, method = msg.get("i"), msg.get("m")
+        if method == "__schema__":
+            # Built-in schema handshake (core/wire.py): reply with our
+            # digest; the CLIENT decides compatibility so old servers
+            # never have to know new messages.
+            from ray_tpu.core.wire import schema_digest
+
+            await self._reply(req_id, ok=True, result=schema_digest())
+            return
         handler = getattr(self._handlers, f"handle_{method}", None)
         if handler is None:
             await self._reply(req_id, ok=False,
@@ -258,7 +273,7 @@ class ServerConnection:
 class RpcClient:
     """Async client with request-response and push-subscription support."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, handshake: bool = True):
         host, port = address.rsplit(":", 1)
         self._host, self._port = host, int(port)
         self._reader: Optional[asyncio.StreamReader] = None
@@ -268,6 +283,7 @@ class RpcClient:
         self._next_id = 0
         self._push_handlers: Dict[str, Callable[[Any], Any]] = {}
         self._reader_task: Optional[asyncio.Task] = None
+        self._handshake = handshake
         self.connected = False
 
     @property
@@ -286,6 +302,31 @@ class RpcClient:
                 self._batch = _BatchedWriter(self._writer, loop)
                 self._reader_task = asyncio.ensure_future(self._read_loop())
                 self.connected = True
+                if self._handshake:
+                    # Version handshake: reject an incompatible peer NOW
+                    # with a typed error instead of corrupting a protocol
+                    # exchange later (core/wire.py evolution rules).
+                    from ray_tpu.core.wire import (SchemaMismatchError,
+                                                   check_digest)
+
+                    try:
+                        digest = await self.call(
+                            "__schema__", timeout=max(5.0, timeout))
+                    except ConnectionLost:
+                        raise          # peer died mid-handshake
+                    except (asyncio.TimeoutError, TimeoutError):
+                        await self.close()
+                        raise ConnectionLost(
+                            f"{self.address}: schema handshake timed out")
+                    except RpcError:
+                        # Pre-handshake server ("no such method"): treat
+                        # as schema-less rather than unreachable.
+                        digest = None
+                    try:
+                        check_digest(digest or {})
+                    except SchemaMismatchError:
+                        await self.close()  # don't leak a half-open client
+                        raise
                 return
             except OSError as e:
                 last_err = e

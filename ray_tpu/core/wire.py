@@ -1,0 +1,363 @@
+"""Typed, versioned wire schema for the core control-plane protocols.
+
+Reference equivalent: the protobuf schema layer
+(`src/ray/protobuf/common.proto` TaskSpec, `gcs_service.proto:63-703`
+table RPCs, `core_worker.proto:422` PushTask). The reference gets message
+typing, versioning, and decode validation from protoc; here the same
+guarantees come from a registry of msgpack-shaped dataclasses:
+
+- every core message declares its fields and types once (`@wire_message`);
+- `to_wire` stamps the message name + schema version into the payload;
+- `from_wire` validates the version and every field's presence and type,
+  raising *typed* errors (`WireDecodeError` / `SchemaMismatchError`) so a
+  malformed or mixed-version peer produces a diagnosable failure instead
+  of a KeyError five frames deep in a handler;
+- `schema_digest()` is exchanged in a connection handshake (rpc.py) so
+  incompatible peers are rejected at connect time, not mid-protocol.
+
+Pickle never appears at this layer: it is reserved for *user* payloads
+(function args/returns), which ride inside `bytes` fields of these typed
+envelopes.
+
+Evolution rules (the proto2-ish contract):
+- adding an optional field (with default) is compatible — old peers omit
+  it, new peers fill the default on decode;
+- unknown fields from a NEWER minor revision are ignored on decode;
+- removing or re-typing a field requires a version bump, which the
+  handshake turns into an explicit `SchemaMismatchError`.
+"""
+
+import dataclasses
+import typing
+from typing import Any, Dict, Optional
+
+from ray_tpu.exceptions import RayError
+
+
+class WireError(RayError):
+    """Base for wire-schema failures."""
+
+
+class WireDecodeError(WireError):
+    """Payload failed schema validation (missing/mistyped/unknown)."""
+
+
+class SchemaMismatchError(WireError):
+    """Peer speaks an incompatible schema version."""
+
+
+_REGISTRY: Dict[str, tuple] = {}   # name -> (cls, version, field specs)
+
+# Wire-type predicates. Containers are validated shallowly (their element
+# types are dynamic in msgpack anyway); `Any` skips the check.
+_CHECKS = {
+    int: lambda v: isinstance(v, int) and not isinstance(v, bool),
+    float: lambda v: isinstance(v, (int, float))
+    and not isinstance(v, bool),
+    str: lambda v: isinstance(v, str),
+    bytes: lambda v: isinstance(v, (bytes, bytearray)),
+    bool: lambda v: isinstance(v, bool),
+    dict: lambda v: isinstance(v, dict),
+    list: lambda v: isinstance(v, (list, tuple)),
+}
+
+
+def _spec_of(hint) -> tuple:
+    """(predicate, optional) for a type hint."""
+    origin = typing.get_origin(hint)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        if len(args) == 1:
+            pred, _ = _spec_of(args[0])
+            return pred, True
+        return None, True
+    if origin in (dict, list, tuple):
+        hint = dict if origin is dict else list
+    if hint is Any:
+        return None, True
+    return _CHECKS.get(hint), False
+
+
+def wire_message(name: str, version: int = 1):
+    """Register a dataclass as a wire message.
+
+    The class gains Mapping-style access (`msg["field"]`, `msg.get`) so
+    protocol handlers written against dict payloads keep working on typed
+    messages unchanged.
+    """
+
+    def deco(cls):
+        cls = dataclasses.dataclass(cls)
+        hints = typing.get_type_hints(cls)
+        specs = []
+        for f in dataclasses.fields(cls):
+            pred, optional = _spec_of(hints[f.name])
+            required = (f.default is dataclasses.MISSING
+                        and f.default_factory is dataclasses.MISSING)
+            specs.append((f.name, pred, optional, required))
+        cls._wire_name = name
+        cls._wire_version = version
+        cls._wire_specs = specs
+
+        def __getitem__(self, key):
+            try:
+                return getattr(self, key)
+            except AttributeError:
+                raise KeyError(key) from None
+
+        def __setitem__(self, key, value):
+            setattr(self, key, value)
+
+        def get(self, key, default=None):
+            return getattr(self, key, default)
+
+        def __contains__(self, key):
+            return hasattr(self, key)
+
+        def as_dict(self):
+            """Plain dict (incl. fields added post-decode), no envelope."""
+            return {k: v for k, v in self.__dict__.items()
+                    if not k.startswith("_wire")}
+
+        def keys(self):
+            return self.as_dict().keys()
+
+        def replace(self, **kw):
+            """Shallow copy with fields overridden (keeps extra
+            post-decode attributes, unlike dataclasses.replace)."""
+            import copy
+
+            dup = copy.copy(self)
+            for k, v in kw.items():
+                setattr(dup, k, v)
+            return dup
+
+        cls.__getitem__ = __getitem__
+        cls.__setitem__ = __setitem__
+        cls.get = get
+        cls.__contains__ = __contains__
+        cls.as_dict = as_dict
+        cls.keys = keys
+        cls.replace = replace
+        if name in _REGISTRY:
+            raise ValueError(f"duplicate wire message {name!r}")
+        _REGISTRY[name] = (cls, version)
+        return cls
+
+    return deco
+
+
+def to_wire(msg) -> Dict[str, Any]:
+    """Typed message -> msgpack-able dict with schema envelope."""
+    name = getattr(msg, "_wire_name", None)
+    if name is None:
+        raise WireError(f"{type(msg).__name__} is not a wire message")
+    d = {"_t": name, "_v": msg._wire_version}
+    d.update(msg.as_dict())
+    return d
+
+
+def from_wire(payload: Any, expect: Optional[str] = None):
+    """Validated decode. Raises WireDecodeError / SchemaMismatchError."""
+    if not isinstance(payload, dict):
+        raise WireDecodeError(
+            f"wire payload must be a map, got {type(payload).__name__}")
+    name = payload.get("_t")
+    if not isinstance(name, str):
+        raise WireDecodeError("payload missing message type tag '_t'")
+    if expect is not None and name != expect:
+        raise WireDecodeError(f"expected {expect!r}, got {name!r}")
+    entry = _REGISTRY.get(name)
+    if entry is None:
+        raise WireDecodeError(f"unknown wire message type {name!r}")
+    cls, version = entry
+    v = payload.get("_v")
+    if not isinstance(v, int):
+        raise WireDecodeError(f"{name}: missing schema version '_v'")
+    if v != version:
+        # Single-integer versions are majors: a bump means fields were
+        # removed or re-typed, so decoding across it is unsafe either way.
+        raise SchemaMismatchError(
+            f"{name}: peer schema v{v}, local v{version}")
+    kwargs = {}
+    for fname, pred, optional, required in cls._wire_specs:
+        if fname in payload:
+            val = payload[fname]
+            if val is None:
+                if not optional:
+                    raise WireDecodeError(
+                        f"{name}.{fname}: null not allowed")
+            elif pred is not None and not pred(val):
+                raise WireDecodeError(
+                    f"{name}.{fname}: bad type {type(val).__name__}")
+            kwargs[fname] = val
+        elif required:
+            raise WireDecodeError(f"{name}: missing field {fname!r}")
+    # Unknown (newer-minor) fields are carried through, not dropped, so a
+    # relay node doesn't silently strip data it doesn't understand.
+    msg = cls(**kwargs)
+    for k, val in payload.items():
+        if k not in ("_t", "_v") and not hasattr(msg, k):
+            object.__setattr__(msg, k, val)
+    return msg
+
+
+def schema_digest() -> Dict[str, int]:
+    """{message name: version} — exchanged in the connect handshake."""
+    return {name: ver for name, (cls, ver) in _REGISTRY.items()}
+
+
+def check_digest(peer: Dict[str, int]) -> None:
+    """Raise SchemaMismatchError if any message BOTH sides know differs
+    in version. One-sided messages are fine (feature skew, not schema
+    skew: the peer simply never sends them)."""
+    # Read the registry directly (not schema_digest()) so tests can fake
+    # a peer by patching schema_digest without also changing "mine".
+    mine = {name: ver for name, (_cls, ver) in _REGISTRY.items()}
+    bad = {n: (v, mine[n]) for n, v in peer.items()
+           if n in mine and mine[n] != v}
+    if bad:
+        detail = ", ".join(f"{n}: peer v{pv} != local v{lv}"
+                           for n, (pv, lv) in sorted(bad.items()))
+        raise SchemaMismatchError(f"incompatible wire schema ({detail})")
+
+
+# ======================================================================
+# Core protocol messages.
+# ======================================================================
+
+@wire_message("TaskSpec", version=1)
+class TaskSpec:
+    """A normal-task invocation (reference: common.proto TaskSpec +
+    core_worker.proto PushTaskRequest)."""
+    task_id: str
+    job_id: str
+    name: str
+    fn_key: str
+    args: bytes
+    num_returns: int = 1
+    arg_oids: list = dataclasses.field(default_factory=list)
+    resources: Dict[str, float] = dataclasses.field(default_factory=dict)
+    owner: Optional[str] = None
+    streaming: bool = False
+    max_retries: int = 0
+    runtime_env: Optional[dict] = None
+    pg: Optional[dict] = None          # {pg_id, bundle_index}
+    visible_chips: Optional[list] = None
+
+
+@wire_message("ActorTaskSpec", version=1)
+class ActorTaskSpec:
+    """An actor-method invocation (reference: common.proto
+    ActorTaskSpec)."""
+    task_id: str
+    job_id: str
+    actor_id: str
+    method: str
+    name: str
+    args: bytes
+    seq: int
+    num_returns: int = 1
+    owner: Optional[str] = None
+    streaming: bool = False
+    concurrency_group: Optional[str] = None
+
+
+@wire_message("LeaseRequest", version=1)
+class LeaseRequest:
+    """Worker-lease request (reference: raylet.proto
+    RequestWorkerLease)."""
+    resources: Dict[str, float]
+    job_id: Optional[str] = None
+    request_id: Optional[str] = None
+    scheduling_key: str = ""
+    is_actor: bool = False
+    spillback_count: int = 0
+    bundle: Optional[list] = None      # (pg_id, bundle_index)
+
+
+@wire_message("LeaseReply", version=1)
+class LeaseReply:
+    """Lease reply: a granted worker, a spillback target, or a typed
+    failure (reference: raylet.proto RequestWorkerLeaseReply)."""
+    granted: Optional[dict] = None     # worker info (address, lease_id…)
+    spillback: Optional[str] = None    # retry at this raylet instead
+    error: Optional[str] = None
+    detail: Optional[str] = None
+
+
+@wire_message("ObjectRequest", version=1)
+class ObjectRequest:
+    """Object fetch/locate request (reference: object_manager.proto
+    Pull/Push)."""
+    oid: str
+    owner_address: Optional[str] = None
+    chunk_index: int = 0
+    pull_timeout: Optional[float] = None
+
+
+@wire_message("ObjectInfo", version=1)
+class ObjectInfo:
+    """Object metadata reply: location set + inline value or shm
+    handle."""
+    oid: str
+    locations: list = dataclasses.field(default_factory=list)
+    size: Optional[int] = None
+    inline: Optional[bytes] = None
+    shm_name: Optional[str] = None
+    error: Optional[str] = None
+
+
+@wire_message("ActorInfo", version=1)
+class ActorInfo:
+    """GCS actor-table record (reference: gcs.proto ActorTableData)."""
+    actor_id: str
+    state: str
+    job_id: Optional[str] = None
+    name: Optional[str] = None
+    namespace: Optional[str] = None
+    address: Optional[str] = None
+    owner: Optional[str] = None
+    class_name: Optional[str] = None
+    max_restarts: int = 0
+    num_restarts: int = 0
+    detached: bool = False
+    death_cause: Optional[str] = None
+    resources: Dict[str, float] = dataclasses.field(default_factory=dict)
+    method_meta: Optional[dict] = None
+
+
+@wire_message("JobInfo", version=1)
+class JobInfo:
+    """GCS job-table record (reference: gcs.proto JobTableData)."""
+    job_id: str
+    driver_pid: Optional[int] = None
+    driver_address: Optional[str] = None
+    namespace: Optional[str] = None
+    sys_path: Optional[list] = None
+    cwd: Optional[str] = None
+    start_time: Optional[float] = None
+    end_time: Optional[float] = None
+    finished: bool = False
+    entrypoint: Optional[str] = None
+    metadata: Optional[dict] = None
+    runtime_env: Optional[dict] = None
+
+
+@wire_message("NodeInfo", version=1)
+class NodeInfo:
+    """GCS node registration (reference: gcs.proto GcsNodeInfo)."""
+    node_id: str
+    address: str
+    object_store_address: Optional[str] = None
+    resources: Dict[str, float] = dataclasses.field(default_factory=dict)
+    labels: Optional[dict] = None
+    is_head: bool = False
+
+
+@wire_message("PubsubMessage", version=1)
+class PubsubMessage:
+    """One pubsub delivery (reference: pubsub.proto PubMessage)."""
+    channel: str
+    data: Any = None
+    seq: Optional[int] = None

@@ -7,12 +7,12 @@ disjoint shard (`session.get_dataset_shard`).
 """
 
 from ray_tpu.data.block import Block
-from ray_tpu.data.dataset import (Dataset, GroupedData, from_items,
-                                  from_numpy, from_pandas, range,
-                                  read_csv, read_json, read_parquet)
+from ray_tpu.data.dataset import (Dataset, GroupedData, from_blocks,
+                                  from_items, from_numpy, from_pandas,
+                                  range, read_csv, read_json, read_parquet)
 
 __all__ = [
-    "Block", "Dataset", "GroupedData", "range", "from_items",
-    "from_numpy", "from_pandas", "read_csv", "read_json",
+    "Block", "Dataset", "GroupedData", "range", "from_blocks",
+    "from_items", "from_numpy", "from_pandas", "read_csv", "read_json",
     "read_parquet",
 ]

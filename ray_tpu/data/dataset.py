@@ -33,6 +33,8 @@ class Dataset:
         self._block_refs = block_refs
 
     _limit: Optional[int] = None
+    _actor_stage: Optional[Any] = None        # compute="actors" stage
+    _post_transforms: List[Callable] = []     # applied after the stage
 
     def _check_not_limited(self, op: str) -> None:
         if self._limit is not None:
@@ -43,8 +45,36 @@ class Dataset:
 
     # -- transforms (lazy) ----------------------------------------------
     def map_batches(self, fn: Callable[[Block], Block],
-                    **_ignored: Any) -> "Dataset":
+                    compute: Optional[str] = None,
+                    **opts: Any) -> "Dataset":
+        """Block -> block transform. compute="actors" runs `fn` on a pool
+        of long-lived actors — pass a callable CLASS to build expensive
+        state (a jitted model) once per replica instead of once per block
+        (reference: actor_pool_map_operator.py; opts: concurrency,
+        fn_constructor_args/kwargs, num_cpus, num_tpus,
+        max_tasks_in_flight_per_actor)."""
         self._check_not_limited("map_batches")
+        if compute == "actors":
+            if self._actor_stage is not None:
+                # Silently dropping the first stage would produce wrong
+                # data; chaining streamed actor stages isn't built yet.
+                raise NotImplementedError(
+                    "chaining two compute=\"actors\" stages is not "
+                    "supported — materialize() between them, or fold the "
+                    "logic into one callable class")
+            from ray_tpu.data.actor_compute import ActorPoolStage
+
+            ds = Dataset(self._read_tasks, self._transforms,
+                         self._block_refs)
+            ds._actor_stage = ActorPoolStage(fn, **opts)
+            return ds
+        if self._actor_stage is not None:
+            # Post-stage transforms apply to the stage's streamed output.
+            ds = Dataset(self._read_tasks, self._transforms,
+                         self._block_refs)
+            ds._actor_stage = self._actor_stage
+            ds._post_transforms = self._post_transforms + [fn]
+            return ds
         return Dataset(self._read_tasks, self._transforms + [fn])
 
     def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]
@@ -187,7 +217,31 @@ class Dataset:
     def iter_blocks(self, max_in_flight: int = 4) -> Iterator[Block]:
         import ray_tpu
 
-        if (self._block_refs is not None and not self._transforms
+        if self._actor_stage is not None:
+            if ray_tpu.is_initialized():
+                blocks = self._actor_stage.run(
+                    self._read_tasks, self._transforms, self._block_refs)
+            else:
+                # No cluster: run the stage's callable in-process (one
+                # "replica"), keeping semantics identical for unit tests.
+                from ray_tpu.data.actor_compute import _MapWorker
+
+                worker = _MapWorker(self._actor_stage.fn,
+                                    self._actor_stage.ctor_args,
+                                    self._actor_stage.ctor_kwargs)
+                ex = self._executor(max_in_flight)
+                blocks = (worker.apply(b) for b in ex.run_local())
+            if self._post_transforms:
+                post = list(self._post_transforms)
+
+                def _applied(src):
+                    for b in src:
+                        for t in post:
+                            b = t(b)
+                        yield b
+
+                blocks = _applied(blocks)
+        elif (self._block_refs is not None and not self._transforms
                 and ray_tpu.is_initialized()):
             blocks = self._iter_block_refs()
         else:
@@ -199,7 +253,7 @@ class Dataset:
         return self._limited(blocks, self._limit)
 
     def _iter_block_refs(self) -> Iterator[Block]:
-        import concurrent.futures as _cf
+        import threading
 
         import ray_tpu
         from ray_tpu.core.worker import current_runtime
@@ -209,27 +263,45 @@ class Dataset:
         refs = list(self._block_refs)
         if not refs:
             return
-        # One-ahead prefetch: fetch block i+1 while the consumer works
-        # on block i (the executor path's fetch/compute overlap).
-        pool = _cf.ThreadPoolExecutor(1, thread_name_prefix="ds-prefetch")
-        try:
-            nxt = pool.submit(ray_tpu.get, refs[0], timeout=600)
-            for i, ref in enumerate(refs):
-                block = nxt.result()
-                if i + 1 < len(refs):
-                    nxt = pool.submit(ray_tpu.get, refs[i + 1],
-                                      timeout=600)
-                yield block
-                del block
-                if release is not None:
-                    # Unmap the consumed block's segment now instead of
-                    # at dataset GC — a streaming consumer's RSS stays
-                    # at ~one block. Deferred automatically while the
-                    # consumer still holds zero-copy views; a
-                    # re-iteration simply re-maps.
-                    release(ref.hex())
-        finally:
-            pool.shutdown(wait=False)
+
+        # One-ahead prefetch on a DAEMON thread: fetch block i+1 while the
+        # consumer works on block i. Daemon matters — a ThreadPoolExecutor
+        # worker is joined by concurrent.futures' atexit hook, so an
+        # abandoned in-flight get (consumer stopped iterating, cluster
+        # gone) would stall interpreter exit for the full get timeout.
+        def fetch(ref):
+            slot: dict = {}
+            ev = threading.Event()
+
+            def run():
+                try:
+                    slot["v"] = ray_tpu.get(ref, timeout=600)
+                except BaseException as e:  # noqa: BLE001
+                    slot["e"] = e
+                finally:
+                    ev.set()
+
+            threading.Thread(target=run, daemon=True,
+                             name="ds-prefetch").start()
+            return ev, slot
+
+        ev, slot = fetch(refs[0])
+        for i, ref in enumerate(refs):
+            ev.wait()
+            if "e" in slot:
+                raise slot["e"]
+            block = slot["v"]
+            if i + 1 < len(refs):
+                ev, slot = fetch(refs[i + 1])
+            yield block
+            del block
+            if release is not None:
+                # Unmap the consumed block's segment now instead of
+                # at dataset GC — a streaming consumer's RSS stays
+                # at ~one block. Deferred automatically while the
+                # consumer still holds zero-copy views; a
+                # re-iteration simply re-maps.
+                release(ref.hex())
 
     @staticmethod
     def _limited(blocks: Iterator[Block], limit: int) -> Iterator[Block]:
@@ -253,6 +325,61 @@ class Dataset:
         if not drop_last or batch_size is None:
             return it
         return (b for b in it if block_num_rows(b) == batch_size)
+
+    def iter_jax_batches(self, *, batch_size: Optional[int] = 256,
+                         prefetch_blocks: int = 4,
+                         drop_last: bool = False,
+                         sharding: Any = None,
+                         mesh: Any = None,
+                         batch_axis: str = "dp",
+                         dtypes: Optional[Dict[str, Any]] = None
+                         ) -> Iterator[Dict[str, Any]]:
+        """Batches as on-device jax.Arrays (reference:
+        `python/ray/data/iterator.py` iter_torch_batches, re-designed for
+        the TPU ingest path):
+
+        - default: each column lands on the default device;
+        - `sharding=NamedSharding(...)` (or `mesh=` + `batch_axis=`, which
+          builds `NamedSharding(mesh, P(batch_axis))`): columns are placed
+          sharded — on a multi-host mesh each host contributes its local
+          shard via `jax.make_array_from_process_local_data`, so the
+          per-host Dataset shard (split_for_workers) becomes one global
+          array without any host ever holding the full batch.
+
+        With `drop_last=False` a short final batch is yielded unsharded
+        (it may not divide the mesh); pass drop_last=True for shapes that
+        must stay static under jit.
+        """
+        import jax
+
+        if sharding is None and mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sharding = NamedSharding(mesh, PartitionSpec(batch_axis))
+
+        def place(name, arr):
+            if dtypes and name in dtypes:
+                arr = arr.astype(dtypes[name])
+            if sharding is not None:
+                n_shards = sharding.num_devices if hasattr(
+                    sharding, "num_devices") else 1
+                if jax.process_count() > 1:
+                    # Multi-host: `arr` is this host's shard — the GLOBAL
+                    # row count is what must divide the mesh (checking
+                    # local % global_devices would reject valid batches
+                    # and silently yield unsharded host-local arrays).
+                    global_rows = arr.shape[0] * jax.process_count()
+                    if global_rows % max(n_shards, 1) == 0:
+                        return jax.make_array_from_process_local_data(
+                            sharding, arr)
+                elif arr.shape[0] % max(n_shards, 1) == 0:
+                    return jax.device_put(arr, sharding)
+            return jax.device_put(arr)
+
+        for block in self.iter_batches(batch_size=batch_size,
+                                       prefetch_blocks=prefetch_blocks,
+                                       drop_last=drop_last):
+            yield {c: place(c, np.asarray(v)) for c, v in block.items()}
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for block in self.iter_blocks():
@@ -420,6 +547,12 @@ def from_items(items: List[Any], *, parallelism: int = 4) -> Dataset:
         return lambda: {"item": np.asarray(rows)}
 
     return Dataset([make_task(c) for c in chunks if len(c)])
+
+
+def from_blocks(blocks: List[Block]) -> Dataset:
+    """One read task per pre-built block (reference:
+    from_blocks/MaterializedDataset)."""
+    return Dataset([(lambda b=b: b) for b in blocks])
 
 
 def from_numpy(arrays: Dict[str, np.ndarray], *,

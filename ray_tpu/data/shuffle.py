@@ -247,9 +247,12 @@ def distributed_sort(read_tasks, transforms, key: str, descending: bool,
     if len(allkeys) == 0 or num_parts <= 1:
         bounds = np.array([])
     else:
-        qs = np.linspace(0, 1, num_parts + 1)[1:-1]
-        bounds = np.unique(np.quantile(np.sort(allkeys), qs,
-                                       method="nearest"))
+        # Index into the sorted sample instead of np.quantile: works for
+        # any sortable dtype (np.quantile raises TypeError on strings).
+        skeys = np.sort(allkeys)
+        idx = np.linspace(0, len(skeys) - 1,
+                          num_parts + 1)[1:-1].round().astype(int)
+        bounds = np.unique(skeys[idx])
     return _exchange(block_refs, [],
                      RangePartitioner(key, bounds, descending),
                      SortFinalize(key, descending), len(bounds) + 1)

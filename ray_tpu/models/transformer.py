@@ -20,6 +20,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from ray_tpu.ops.attention import attention
@@ -128,13 +129,24 @@ _REMAT_POLICIES = {
     None: None,
     "dots": "dots_with_no_batch_dims_saveable",
     "dots_batch": "dots_saveable",
+    # Save ONLY the attention outputs (checkpoint_name'd in _layer):
+    # ~B*S*d bf16 per layer — 50 MB at 16x1024x1536 — buys the backward
+    # out of re-running the flash kernel (the priciest recompute in the
+    # layer: the only O(S^2) op). The FLOPs/HBM sweet spot on v5e.
+    "save_attn": ("names", ("attn_out",)),
+    # Additionally save the fused QKV projection (3x bigger than
+    # attn_out): backward skips the qkv GEMM recompute too. Worth it
+    # when HBM has headroom.
+    "save_attn_qkv": ("names", ("attn_out", "qkv")),
 }
 
 
 def _checkpoint_layer(fn, policy_name):
     policy = None
     mapped = _REMAT_POLICIES.get(policy_name, policy_name)
-    if mapped:
+    if isinstance(mapped, tuple) and mapped[0] == "names":
+        policy = jax.checkpoint_policies.save_only_these_names(*mapped[1])
+    elif mapped:
         policy = getattr(jax.checkpoint_policies, mapped)
     return jax.checkpoint(fn, static_argnums=(2, 3, 4), policy=policy)
 
@@ -153,6 +165,7 @@ def _layer(x, lp, cfg: TransformerConfig, mesh, manual_sp, cos, sin,
     # -- attention block -----------------------------------------------
     y = _rmsnorm(x, lp["ln1"])
     qkv = jnp.einsum("bsd,dkh->kbsh", y, lp["wqkv"].astype(act))
+    qkv = checkpoint_name(qkv, "qkv")
     q = qkv[0].reshape(b, s, h, hd)
     k = qkv[1].reshape(b, s, h, hd)
     v = qkv[2].reshape(b, s, h, hd)
@@ -168,6 +181,7 @@ def _layer(x, lp, cfg: TransformerConfig, mesh, manual_sp, cos, sin,
                    for t in (q, k, v))
     o = attention(q, k, v, causal=True, mesh=mesh, positions=positions,
                   manual_sp=manual_sp)
+    o = checkpoint_name(o, "attn_out")
     x = x + (o.reshape(b, s, h * hd) @ lp["wo"].astype(act))
 
     # -- FFN block ------------------------------------------------------

@@ -443,7 +443,10 @@ class Store {
   bool alloc_segment(Entry& e) {
     uint64_t cls = pool_class(e.size);
     if (cls) {
-      want_[cls] = kPoolTargetPerClass;
+      // Only the REFILL request is gated on past fallocate failures —
+      // segments of the class already pooled must still be handed out,
+      // or their bytes strand against pool_budget_ forever.
+      if (!prealloc_failed_.count(cls)) want_[cls] = kPoolTargetPerClass;
       auto pit = pool_.begin();
       while (pit != pool_.end() && pit->size != cls) ++pit;
       pool_cv_.notify_one();  // hit: refill / miss: note the demand
@@ -505,7 +508,12 @@ class Store {
       }
       lk.lock();
       if (!ok) {
-        want_.erase(need);  // tmpfs full / clash: stop chasing this class
+        // tmpfs full / unsupported: stop chasing this class permanently —
+        // alloc_segment re-requests on every create, and without the
+        // failed set each create would trigger a futile
+        // shm_open+ftruncate+fallocate+unlink cycle here.
+        prealloc_failed_.insert(need);
+        want_.erase(need);
         continue;
       }
       if (stopping_ || pool_bytes_ + need > pool_budget_) {
@@ -770,6 +778,7 @@ class Store {
   uint64_t pool_bytes_ = 0;
   uint64_t pool_budget_ = 0;
   std::unordered_map<uint64_t, int> want_;  // size class -> target count
+  std::unordered_set<uint64_t> prealloc_failed_;  // classes fallocate rejected
 };
 
 }  // namespace
