@@ -151,6 +151,12 @@ _D("tpu_slice_gang_scheduling", True,
 _D("collective_timeout_s", 300.0, "Out-of-graph collective op timeout.")
 _D("gcs_wal_compact_bytes", 4 * 1024 * 1024,
    "GCS write-ahead-log size that triggers snapshot compaction.")
+_D("object_pull_budget_bytes", 256 * 1024 * 1024,
+   "Byte budget for concurrent inbound object transfers "
+   "(reference: pull_manager.h admission control).")
+_D("object_push_concurrency", 8,
+   "Max concurrent outbound object-chunk serves per raylet "
+   "(reference: push_manager.h bounded in-flight pushes).")
 
 _config = Config()
 

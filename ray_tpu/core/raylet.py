@@ -86,6 +86,56 @@ class _PendingLease:
         self.future: asyncio.Future = asyncio.get_event_loop().create_future()
 
 
+class _PullManager:
+    """Admission control for inbound object transfers (reference:
+    `object_manager/pull_manager.h:52` — pulls activate under a byte
+    budget, the rest queue). Smallest-first wake order: a giant transfer
+    must not head-of-line-block the small objects a blocked `get` needs.
+    """
+
+    def __init__(self, budget_bytes: int):
+        import heapq as _hq  # noqa: F401  (documents the waiter heap)
+
+        self.budget = max(1, int(budget_bytes))
+        self.in_use = 0
+        self._waiters: list = []   # heap of (size, seq, Event)
+        self._seq = 0
+        self.stats = {"admitted": 0, "queued": 0, "peak_bytes": 0,
+                      "active": 0}
+
+    async def admit(self, size: int) -> int:
+        """Blocks until `size` bytes of transfer budget are granted.
+        Returns the granted size (a single object larger than the whole
+        budget is clamped: it transfers alone, not never)."""
+        import heapq
+
+        size = min(int(size), self.budget)
+        if not self._waiters and self.in_use + size <= self.budget:
+            self.in_use += size
+        else:
+            ev = asyncio.Event()
+            self._seq += 1
+            heapq.heappush(self._waiters, (size, self._seq, ev))
+            self.stats["queued"] += 1
+            await ev.wait()
+        self.stats["admitted"] += 1
+        self.stats["active"] += 1
+        self.stats["peak_bytes"] = max(self.stats["peak_bytes"],
+                                       self.in_use)
+        return size
+
+    def release(self, size: int) -> None:
+        import heapq
+
+        self.in_use -= size
+        self.stats["active"] -= 1
+        while self._waiters and \
+                self.in_use + self._waiters[0][0] <= self.budget:
+            wsize, _, ev = heapq.heappop(self._waiters)
+            self.in_use += wsize
+            ev.set()
+
+
 class Raylet:
     def __init__(self, *, node_id: str, gcs_address: str,
                  resources: Dict[str, float],
@@ -134,6 +184,13 @@ class Raylet:
         # a generic crash (reference: worker_killing_policy.h + the
         # OOM-kill task-failure reason in node_manager.cc).
         self._death_causes: Dict[str, str] = {}
+        # Object-manager flow control (reference: pull_manager.h
+        # admission under a byte budget; push_manager.h bounded
+        # concurrent outbound chunks).
+        self._pulls = _PullManager(ray_config().object_pull_budget_bytes)
+        self._inflight_pulls: Dict[str, asyncio.Future] = {}
+        self._push_sem: Optional[asyncio.Semaphore] = None
+        self._push_waiters = 0
 
     @property
     def address(self) -> str:
@@ -1047,6 +1104,16 @@ class Raylet:
             return None
         return {"size": size}
 
+    def _push_gate(self) -> asyncio.Semaphore:
+        """Push-side backpressure (reference: push_manager.h:30 bounded
+        in-flight pushes): at most `object_push_concurrency` chunk serves
+        run at once, so an N-way broadcast queues here instead of
+        thrashing the store threadpool and starving the lease plane."""
+        if self._push_sem is None:
+            self._push_sem = asyncio.Semaphore(
+                ray_config().object_push_concurrency)
+        return self._push_sem
+
     async def handle_read_object_chunk(self, conn: ServerConnection, *,
                                        oid: str, offset: int,
                                        length: int) -> Optional[bytes]:
@@ -1054,11 +1121,19 @@ class Raylet:
         chunked transfer). Returns None if the object vanished."""
         if not self.store.contains(oid):
             return None
+        gate = self._push_gate()
+        self._push_waiters += 1
+        try:
+            await gate.acquire()
+        finally:
+            self._push_waiters -= 1
         try:
             return await self._store_io(
                 self.store.read_range, oid, offset, length)
         except KeyError:
             return None
+        finally:
+            gate.release()
 
     # Large objects stream in 1 MiB frames so a multi-GB transfer neither
     # doubles peak memory nor monopolizes either event loop.
@@ -1067,8 +1142,33 @@ class Raylet:
         return ray_config().object_transfer_chunk_bytes
 
     async def _pull_from_holder(self, remote, oid: str) -> bool:
-        """Copy `oid` from a remote raylet into the local store. Returns
-        False if the holder no longer has it."""
+        """Copy `oid` from a remote raylet into the local store, deduped
+        (concurrent pulls of one object share a single transfer) and
+        admission-controlled (pull_manager byte budget). Returns False if
+        the holder no longer has it."""
+        inflight = self._inflight_pulls.get(oid)
+        if inflight is not None:
+            return await asyncio.shield(inflight)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._inflight_pulls[oid] = fut
+        try:
+            ok = await self._pull_from_holder_inner(remote, oid)
+            fut.set_result(ok)
+            return ok
+        except BaseException as e:
+            fut.set_exception(e)
+            # A shielded waiter may never await the future after its own
+            # cancellation; mark retrieved so asyncio doesn't log
+            # "exception was never retrieved".
+            try:
+                fut.exception()
+            except Exception:
+                pass
+            raise
+        finally:
+            self._inflight_pulls.pop(oid, None)
+
+    async def _pull_from_holder_inner(self, remote, oid: str) -> bool:
         meta = await remote.call("object_meta", oid=oid, timeout=30.0)
         if meta is None:
             return False
@@ -1081,29 +1181,33 @@ class Raylet:
             return True
         if self.store.contains(oid):
             return True
+        granted = await self._pulls.admit(size)
         try:
-            await self._store_io(self.store.create, oid, size)
-        except FileExistsError:
-            # A concurrent pull sealed it between contains() and here.
-            return self.store.contains(oid)
-        try:
-            for offset in range(0, size, self.TRANSFER_CHUNK):
-                chunk = await remote.call(
-                    "read_object_chunk", oid=oid, offset=offset,
-                    length=self.TRANSFER_CHUNK, timeout=60.0)
-                if chunk is None:
-                    raise KeyError(f"{oid[:8]} evicted mid-transfer")
-                await self._store_io(
-                    self.store.write_range, oid, offset, chunk)
-            self.store.seal(oid)
-        except BaseException:
-            # Only roll back an entry WE still own unsealed — a
-            # concurrent pull may have sealed it and handed readers the
-            # mapping (contains() == sealed).
-            if not self.store.contains(oid):
-                self.store.delete(oid)
-            raise
-        return True
+            try:
+                await self._store_io(self.store.create, oid, size)
+            except FileExistsError:
+                # A concurrent pull sealed it between contains() and here.
+                return self.store.contains(oid)
+            try:
+                for offset in range(0, size, self.TRANSFER_CHUNK):
+                    chunk = await remote.call(
+                        "read_object_chunk", oid=oid, offset=offset,
+                        length=self.TRANSFER_CHUNK, timeout=60.0)
+                    if chunk is None:
+                        raise KeyError(f"{oid[:8]} evicted mid-transfer")
+                    await self._store_io(
+                        self.store.write_range, oid, offset, chunk)
+                self.store.seal(oid)
+            except BaseException:
+                # Only roll back an entry WE still own unsealed — a
+                # concurrent pull may have sealed it and handed readers
+                # the mapping (contains() == sealed).
+                if not self.store.contains(oid):
+                    self.store.delete(oid)
+                raise
+            return True
+        finally:
+            self._pulls.release(granted)
 
     async def handle_put_object(self, conn: ServerConnection, *,
                                 oid: str, data: bytes) -> bool:
@@ -1262,6 +1366,13 @@ class Raylet:
                             "committed": b.committed}
                         for k, b in self._bundles.items() if not b.removed},
             "store": self.store.stats(),
+            "object_manager": {
+                **self._pulls.stats,
+                "budget_bytes": self._pulls.budget,
+                "in_use_bytes": self._pulls.in_use,
+                "inflight_pulls": len(self._inflight_pulls),
+                "push_waiters": self._push_waiters,
+            },
         }
 
     async def handle_ping(self, conn: ServerConnection) -> str:

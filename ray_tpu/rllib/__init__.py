@@ -9,6 +9,8 @@ from ray_tpu.rllib.algorithms.dqn import (DQN, DQNConfig, DQNLearner,
                                           ReplayBuffer)
 from ray_tpu.rllib.algorithms.impala import (APPO, APPOConfig, IMPALA,
                                              IMPALAConfig)
+from ray_tpu.rllib.algorithms.multi_agent_ppo import (MultiAgentPPO,
+                                                      MultiAgentPPOConfig)
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.core.impala_learner import ImpalaLearner
 from ray_tpu.rllib.core.learner import PPOLearner
@@ -16,10 +18,17 @@ from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.core.rl_module import DiscreteMLPModule
 from ray_tpu.rllib.env.env_runner import (SingleAgentEnvRunner,
                                           compute_gae)
+from ray_tpu.rllib.env.multi_agent_env import (MultiAgentEnv,
+                                               MultiAgentEnvRunner)
+from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
+                                                ReservoirReplayBuffer)
 
 __all__ = [
     "PPO", "PPOConfig", "PPOLearner", "LearnerGroup",
     "IMPALA", "IMPALAConfig", "APPO", "APPOConfig", "ImpalaLearner",
     "DQN", "DQNConfig", "DQNLearner", "ReplayBuffer",
+    "PrioritizedReplayBuffer", "ReservoirReplayBuffer",
+    "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
+    "MultiAgentPPOConfig",
     "DiscreteMLPModule", "SingleAgentEnvRunner", "compute_gae",
 ]

@@ -37,6 +37,12 @@ class DQNConfig:
     updates_per_iteration: int = 50
     target_network_update_freq: int = 200   # learner updates
     double_q: bool = True
+    # Prioritized replay (Schaul et al.; reference
+    # prioritized_replay_buffer.py): sample ∝ |td|^alpha with
+    # importance weights annealing via beta.
+    prioritized_replay: bool = False
+    prioritized_replay_alpha: float = 0.6
+    prioritized_replay_beta: float = 0.4
     epsilon_start: float = 1.0
     epsilon_end: float = 0.05
     epsilon_decay_steps: int = 5_000        # env steps
@@ -49,65 +55,10 @@ class DQNConfig:
         return DQN(self)
 
 
-class ReplayBuffer:
-    """Uniform FIFO replay (reference:
-    `rllib/utils/replay_buffers/replay_buffer.py`). Ring-buffer list:
-    O(1) random access (a deque indexes in O(n), which would dominate
-    the jitted learner step at 50k capacity)."""
-
-    def __init__(self, capacity: int, seed: int = 0):
-        self.capacity = capacity
-        self._storage: list = []
-        self._insert = 0
-        self.rng = np.random.default_rng(seed)
-
-    def __len__(self) -> int:
-        return len(self._storage)
-
-    def _append(self, row) -> None:
-        if len(self._storage) < self.capacity:
-            self._storage.append(row)
-        else:
-            self._storage[self._insert] = row
-            self._insert = (self._insert + 1) % self.capacity
-
-    def add_fragment(self, rollout: Dict[str, np.ndarray]) -> int:
-        """Flatten a time-major [T, n_envs] fragment into transitions.
-
-        Bootstrap mask = `terminateds` ONLY: a time-limit truncation is
-        not a terminal state, so its target must bootstrap — from the
-        TRUE final observation the limit cut off (`trunc_obs`), not the
-        post-reset obs that follows it in the fragment."""
-        obs, actions = rollout["obs"], rollout["actions"]
-        rewards = rollout["rewards"]
-        terms = rollout.get("terminateds", rollout["dones"])
-        T, n_envs = actions.shape
-        next_obs = np.concatenate(
-            [obs[1:], rollout["final_obs"][None]], axis=0).copy()
-        for k in range(len(rollout.get("trunc_t", ()))):
-            next_obs[rollout["trunc_t"][k], rollout["trunc_env"][k]] = \
-                rollout["trunc_obs"][k]
-        n = 0
-        for t in range(T):
-            for e in range(n_envs):
-                self._append(
-                    (obs[t, e], int(actions[t, e]),
-                     float(rewards[t, e]), next_obs[t, e],
-                     float(terms[t, e])))
-                n += 1
-        return n
-
-    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
-        idx = self.rng.integers(0, len(self._storage), size=batch_size)
-        rows = [self._storage[i] for i in idx]
-        obs, actions, rewards, next_obs, dones = zip(*rows)
-        return {
-            "obs": np.stack(obs).astype(np.float32),
-            "actions": np.asarray(actions, np.int32),
-            "rewards": np.asarray(rewards, np.float32),
-            "next_obs": np.stack(next_obs).astype(np.float32),
-            "dones": np.asarray(dones, np.float32),
-        }
+# Buffer library lives in rllib/utils/replay_buffers.py (uniform,
+# prioritized sum-tree, reservoir); re-exported here for back-compat.
+from ray_tpu.rllib.utils.replay_buffers import (  # noqa: E402
+    PrioritizedReplayBuffer, ReplayBuffer)
 
 
 def dqn_loss(module, params, target_params, batch, *, gamma: float,
@@ -131,10 +82,15 @@ def dqn_loss(module, params, target_params, batch, *, gamma: float,
         jax.lax.stop_gradient(q_next)
     td = q_sel - target
     # Huber: robust to the reward spikes of freshly-exploring policies.
-    loss = jnp.mean(jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
-                              jnp.abs(td) - 0.5))
+    # Per-sample importance weights (all-ones for uniform replay) keep
+    # the prioritized sampling bias corrected.
+    w = batch.get("weights", jnp.ones_like(td))
+    huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                      jnp.abs(td) - 0.5)
+    loss = jnp.mean(w * huber)
     return loss, {"td_error_mean": jnp.mean(jnp.abs(td)),
-                  "q_mean": jnp.mean(q_sel), "total_loss": loss}
+                  "q_mean": jnp.mean(q_sel), "total_loss": loss,
+                  "td_abs": jnp.abs(td)}
 
 
 class DQNLearner:
@@ -174,17 +130,21 @@ class DQNLearner:
 
         return jax.jit(step)
 
-    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
         import jax
         import jax.numpy as jnp
 
-        mb = {k: jnp.asarray(v) for k, v in batch.items()}
+        mb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k != "idx"}
         self.params, self.opt_state, stats = self._step(
             self.params, self.target_params, self.opt_state, mb)
         self._updates += 1
         if self._updates % self._target_freq == 0:
             self.target_params = jax.tree.map(lambda x: x, self.params)
-        return {k: float(v) for k, v in stats.items()}
+        td_abs = np.asarray(stats.pop("td_abs"))
+        out: Dict[str, Any] = {k: float(v) for k, v in stats.items()}
+        out["td_abs"] = td_abs      # per-sample |td| for PER updates
+        return out
 
     def get_weights(self):
         import jax
@@ -259,7 +219,13 @@ class DQN:
              "target_network_update_freq":
                  config.target_network_update_freq,
              "seed": config.seed})
-        self.buffer = ReplayBuffer(config.buffer_size, seed=config.seed)
+        if config.prioritized_replay:
+            self.buffer: ReplayBuffer = PrioritizedReplayBuffer(
+                config.buffer_size, seed=config.seed,
+                alpha=config.prioritized_replay_alpha)
+        else:
+            self.buffer = ReplayBuffer(config.buffer_size,
+                                       seed=config.seed)
 
         runner_cls = ray_tpu.remote(num_cpus=1, max_concurrency=2)(
             SingleAgentEnvRunner)
@@ -304,10 +270,22 @@ class DQN:
         stats: Dict[str, float] = {}
         updates = 0
         if self._total_steps >= cfg.learning_starts:
+            prioritized = isinstance(self.buffer,
+                                     PrioritizedReplayBuffer)
             for _ in range(cfg.updates_per_iteration):
-                stats = self.learner.update(
-                    self.buffer.sample(cfg.train_batch_size))
+                if prioritized:
+                    batch = self.buffer.sample(
+                        cfg.train_batch_size,
+                        beta=cfg.prioritized_replay_beta)
+                else:
+                    batch = self.buffer.sample(cfg.train_batch_size)
+                stats = self.learner.update(batch)
+                # Refresh sampled transitions' priorities with their
+                # fresh |td| (the PER feedback loop).
+                self.buffer.update_priorities(batch["idx"],
+                                              stats.pop("td_abs"))
                 updates += 1
+            stats.pop("td_abs", None)
         self._sync_weights()
         self.iteration += 1
         wall = time.monotonic() - t0

@@ -1,0 +1,1 @@
+"""RLlib utility libraries (reference: rllib/utils/)."""

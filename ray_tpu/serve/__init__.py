@@ -24,10 +24,24 @@ from ray_tpu.serve.exceptions import (DeploymentUnavailableError,
                                       RayServeException,
                                       ReplicaDrainingError)
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import (get_multiplexed_model_id,
+                                     multiplexed)
+
+
+def __getattr__(name):
+    # Lazy: grpc imports only when the ingress is actually used.
+    if name == "GrpcServeClient":
+        from ray_tpu.serve._private.grpc_proxy import GrpcServeClient
+
+        return GrpcServeClient
+    raise AttributeError(name)
+
 
 __all__ = [
     "deployment", "run", "delete", "shutdown", "status",
     "get_app_handle", "get_deployment_handle", "batch",
+    "multiplexed", "get_multiplexed_model_id", "start_grpc_ingress",
+    "GrpcServeClient",
     "Deployment", "Application", "DeploymentHandle",
     "DeploymentResponse", "AutoscalingConfig", "DeploymentConfig",
     "HTTPOptions", "RayServeException", "ReplicaDrainingError",
@@ -36,6 +50,8 @@ __all__ = [
 
 _PROXY_NAME = "SERVE_PROXY"
 _http_port: Optional[int] = None
+_GRPC_PROXY_NAME = "SERVE_GRPC_PROXY"
+_grpc_port: Optional[int] = None
 
 
 class Deployment:
@@ -203,6 +219,28 @@ def start(http_options: Optional[HTTPOptions] = None) -> int:
     return _http_port
 
 
+def start_grpc_ingress(port: int = 0, host: str = "127.0.0.1") -> int:
+    """Start (or find) the gRPC ingress (reference: serve.start's
+    grpc_options / gRPCProxy): a detached actor serving
+    /ray_tpu.serve.ServeAPIService/Call. Returns the bound port; reach it
+    with `serve.GrpcServeClient(f"127.0.0.1:{port}")`."""
+    global _grpc_port
+    import ray_tpu
+    from ray_tpu.serve._private.grpc_proxy import GrpcIngress
+
+    start()
+    try:
+        proxy = ray_tpu.get_actor(_GRPC_PROXY_NAME)
+    except Exception:
+        actor_cls = ray_tpu.remote(num_cpus=0, name=_GRPC_PROXY_NAME,
+                                   max_concurrency=64)(GrpcIngress)
+        proxy = actor_cls.remote(host, port)
+        _grpc_port = ray_tpu.get(proxy.start.remote(), timeout=60)
+    if _grpc_port is None:
+        _grpc_port = ray_tpu.get(proxy.start.remote(), timeout=60)
+    return _grpc_port
+
+
 def run(app: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/",
         wait_for_ready: bool = True,
@@ -327,6 +365,14 @@ def shutdown() -> None:
         ray_tpu.kill(proxy)
     except Exception:
         pass
+    try:
+        gproxy = ray_tpu.get_actor(_GRPC_PROXY_NAME)
+        ray_tpu.get(gproxy.stop.remote(), timeout=10)
+        ray_tpu.kill(gproxy)
+    except Exception:
+        pass
+    global _grpc_port
+    _grpc_port = None
     _http_port = None
 
 

@@ -1,0 +1,173 @@
+"""gRPC ingress: the non-HTTP data plane.
+
+Reference equivalent: the gRPC proxy of
+`python/ray/serve/_private/proxy.py` (gRPCProxy) + `serve.start(
+grpc_options=...)`. No protoc codegen: a generic method handler serves
+
+    /ray_tpu.serve.ServeAPIService/Call
+
+with msgpack-framed request metadata and pickled payloads — the same
+zero-codegen stance as the core RPC layer. Request metadata also rides
+gRPC metadata headers (`application`, `method_name`,
+`multiplexed_model_id`) so non-Python clients can route without
+understanding the body encoding.
+
+Request body : msgpack {app?, deployment?, method?, model_id?,
+               payload: pickled (args, kwargs)}
+Response body: msgpack {ok: bool, payload?: pickled result, error?: str}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import pickle
+import threading
+from typing import Any, Dict, Optional
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+SERVICE = "ray_tpu.serve.ServeAPIService"
+
+
+class GrpcIngress:
+    """Serves deployment calls over gRPC (grpc.aio, generic handler)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host, self._port = host, port
+        self._server = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._handles: Dict[str, Any] = {}
+        self._started = threading.Event()
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> int:
+        """Boot the aio server on a dedicated thread; returns the bound
+        port. Idempotent — a second call returns the running port."""
+        if self._started.is_set():
+            return self._port
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-grpc-ingress")
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError("gRPC ingress failed to start")
+        return self._port
+
+    def _run(self) -> None:
+        asyncio.run(self._serve())
+
+    async def _serve(self) -> None:
+        import grpc
+
+        self._loop = asyncio.get_running_loop()
+        handler = grpc.method_handlers_generic_handler(
+            SERVICE,
+            {"Call": grpc.unary_unary_rpc_method_handler(
+                self._call,
+                request_deserializer=None,     # raw bytes in/out
+                response_serializer=None)})
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((handler,))
+        self._port = self._server.add_insecure_port(
+            f"{self._host}:{self._port}")
+        await self._server.start()
+        self._started.set()
+        await self._server.wait_for_termination()
+
+    def stop(self) -> None:
+        if self._loop is not None and self._server is not None:
+            async def _stop():
+                await self._server.stop(grace=2.0)
+
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    _stop(), self._loop).result(timeout=10)
+            except Exception:
+                pass
+
+    # -- data plane -----------------------------------------------------
+    def _handle_for(self, deployment: str):
+        handle = self._handles.get(deployment)
+        if handle is None:
+            from ray_tpu import serve
+
+            try:
+                handle = serve.get_app_handle(deployment)
+            except Exception:
+                handle = serve.get_deployment_handle(deployment)
+            self._handles[deployment] = handle
+        return handle
+
+    async def _call(self, request: bytes, context) -> bytes:
+        try:
+            meta = {k: v for k, v in (context.invocation_metadata() or ())}
+            req = msgpack.unpackb(request, raw=False) \
+                if request else {}
+            deployment = (req.get("app") or req.get("deployment")
+                          or meta.get("application"))
+            if not deployment:
+                raise ValueError(
+                    "no target: set 'app' in the request body or the "
+                    "'application' metadata key")
+            method = (req.get("method") or meta.get("method_name")
+                      or "__call__")
+            model_id = (req.get("model_id")
+                        or meta.get("multiplexed_model_id") or "")
+            if req.get("payload") is not None:
+                args, kwargs = pickle.loads(req["payload"])
+            else:
+                args, kwargs = (), {}
+            handle = self._handle_for(deployment)
+            if model_id:
+                handle = handle.options(multiplexed_model_id=model_id)
+            if method != "__call__":
+                handle = handle.options(method_name=method)
+            # handle.remote().result() blocks a worker thread, not the
+            # aio loop.
+            resp = handle.remote(*args, **kwargs)
+            result = await asyncio.to_thread(resp.result, 60.0)
+            return msgpack.packb(
+                {"ok": True, "payload": pickle.dumps(result)},
+                use_bin_type=True)
+        except Exception as e:  # noqa: BLE001
+            logger.debug("grpc ingress call failed", exc_info=True)
+            return msgpack.packb(
+                {"ok": False, "error": f"{type(e).__name__}: {e}"},
+                use_bin_type=True)
+
+
+class GrpcServeClient:
+    """Minimal client for the ingress (reference: the generated
+    RayServeAPIServiceStub, hand-rolled over a generic channel)."""
+
+    def __init__(self, address: str):
+        import grpc
+
+        self._channel = grpc.insecure_channel(address)
+        self._call = self._channel.unary_unary(
+            f"/{SERVICE}/Call",
+            request_serializer=None, response_deserializer=None)
+
+    def call(self, app: str, *args, method: str = "__call__",
+             model_id: str = "", timeout: float = 60.0, **kwargs) -> Any:
+        req = msgpack.packb({
+            "app": app, "method": method, "model_id": model_id,
+            "payload": pickle.dumps((args, kwargs)),
+        }, use_bin_type=True)
+        raw = self._call(req, timeout=timeout)
+        resp = msgpack.unpackb(raw, raw=False)
+        if not resp.get("ok"):
+            from ray_tpu.serve.exceptions import RayServeException
+
+            raise RayServeException(resp.get("error", "ingress error"))
+        return pickle.loads(resp["payload"])
+
+    def close(self) -> None:
+        self._channel.close()

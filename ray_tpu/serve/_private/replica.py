@@ -56,7 +56,8 @@ class Replica:
 
     # -- data plane ----------------------------------------------------
     async def handle_request(self, method_name: str, args: Tuple,
-                             kwargs: Dict) -> Any:
+                             kwargs: Dict,
+                             metadata: Optional[Dict] = None) -> Any:
         if self._draining:
             from ray_tpu.serve.exceptions import ReplicaDrainingError
 
@@ -64,6 +65,12 @@ class Replica:
                 f"replica {self.replica_id} is draining")
         self._ongoing += 1
         self._total += 1
+        token = None
+        if metadata and metadata.get("multiplexed_model_id"):
+            from ray_tpu.serve.multiplex import _set_request_model_id
+
+            token = _set_request_model_id(
+                metadata["multiplexed_model_id"])
         try:
             target = self._instance if method_name == "__call__" else None
             method = (getattr(self._instance, method_name)
@@ -74,6 +81,10 @@ class Replica:
             return await asyncio.to_thread(method, *args, **kwargs)
         finally:
             self._ongoing -= 1
+            if token is not None:
+                from ray_tpu.serve.multiplex import _request_model_id
+
+                _request_model_id.reset(token)
 
     def _resolve_call(self):
         call = getattr(self._instance, "__call__", None)

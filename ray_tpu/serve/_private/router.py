@@ -24,6 +24,10 @@ class Router:
         self._version = -2
         self._last_refresh = 0.0
         self._inflight: Dict[str, int] = {}
+        # model_id -> replica_id affinity (multiplexed routing: keep a
+        # model's requests on the replica that already loaded it;
+        # reference: the multiplexed scheduling of replica_scheduler.py).
+        self._model_affinity: Dict[str, str] = {}
         self._lock = threading.Lock()
         self._poller: Optional[threading.Thread] = None
 
@@ -91,11 +95,28 @@ class Router:
             except Exception:
                 time.sleep(1.0)  # controller restarting: retry
 
-    def _choose(self) -> Tuple[str, Any]:
+    def _choose(self, model_id: Optional[str] = None) -> Tuple[str, Any]:
         with self._lock:
             replicas = list(self._replicas)
         if not replicas:
             raise _NoReplicas()
+        if model_id:
+            # Affinity first: the replica that last served this model has
+            # it warm in its multiplex LRU — unless it's clearly
+            # overloaded vs the p2c alternative (2x + 4 queue slack).
+            with self._lock:
+                pinned = self._model_affinity.get(model_id)
+            match = next((r for r in replicas if r[0] == pinned), None)
+            if match is not None:
+                others = [r for r in replicas if r[0] != pinned]
+                if not others:
+                    return match
+                alt = random.choice(others)
+                with self._lock:
+                    lp = self._inflight.get(match[0], 0)
+                    la = self._inflight.get(alt[0], 0)
+                if lp <= 2 * la + 4:
+                    return match
         if len(replicas) == 1:
             return replicas[0]
         a, b = random.sample(replicas, 2)
@@ -105,7 +126,8 @@ class Router:
         return a if la <= lb else b
 
     def assign(self, method_name: str, args: tuple, kwargs: dict,
-               timeout_s: float = 30.0):
+               timeout_s: float = 30.0,
+               model_id: Optional[str] = None):
         """Pick a replica and submit; returns (replica_id, ObjectRef).
         Blocks (with backoff) while the deployment has no running
         replica — e.g. mid-startup."""
@@ -113,7 +135,7 @@ class Router:
         self._refresh()
         while True:
             try:
-                replica_id, handle = self._choose()
+                replica_id, handle = self._choose(model_id)
                 break
             except _NoReplicas:
                 if time.monotonic() > deadline:
@@ -128,7 +150,15 @@ class Router:
         with self._lock:
             self._inflight[replica_id] = \
                 self._inflight.get(replica_id, 0) + 1
-        ref = handle.handle_request.remote(method_name, args, kwargs)
+            if model_id:
+                self._model_affinity[model_id] = replica_id
+        metadata = ({"multiplexed_model_id": model_id}
+                    if model_id else None)
+        if metadata is not None:
+            ref = handle.handle_request.remote(method_name, args, kwargs,
+                                               metadata)
+        else:
+            ref = handle.handle_request.remote(method_name, args, kwargs)
         return replica_id, ref
 
     def complete(self, replica_id: str) -> None:

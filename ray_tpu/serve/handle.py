@@ -97,30 +97,51 @@ class DeploymentResponse:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller_handle,
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__",
+                 multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self._controller = controller_handle
         self._method_name = method_name
-        self.__router = None
+        self._multiplexed_model_id = multiplexed_model_id
+        # Shared one-slot holder: every options() variant of this handle
+        # uses the SAME Router (and its poller thread + model-affinity
+        # cache) — a per-request options() call must never mint routers.
+        self.__router_slot: list = [None]
 
     @property
     def _router(self):
-        if self.__router is None:
+        if self.__router_slot[0] is None:
             from ray_tpu.serve._private.router import Router
 
-            self.__router = Router(self._controller, self.deployment_name)
-        return self.__router
+            self.__router_slot[0] = Router(self._controller,
+                                           self.deployment_name)
+        return self.__router_slot[0]
 
-    def options(self, method_name: str) -> "DeploymentHandle":
-        return DeploymentHandle(self.deployment_name, self._controller,
-                                method_name=method_name)
+    def options(self, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        """Per-request options (reference: handle.options): method_name
+        routes to a named method; multiplexed_model_id tags the request
+        for model-multiplexed replicas (serve/multiplex.py) and makes the
+        router prefer a replica with that model already warm."""
+        dup = DeploymentHandle(
+            self.deployment_name, self._controller,
+            method_name=(self._method_name if method_name is None
+                         else method_name),
+            multiplexed_model_id=(
+                self._multiplexed_model_id
+                if multiplexed_model_id is None else multiplexed_model_id))
+        dup._DeploymentHandle__router_slot = self.__router_slot
+        return dup
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return self.remote_method(self._method_name, args, kwargs)
 
     def remote_method(self, method_name: str, args, kwargs
                       ) -> DeploymentResponse:
-        replica_id, ref = self._router.assign(method_name, args, kwargs)
+        replica_id, ref = self._router.assign(
+            method_name, args, kwargs,
+            model_id=self._multiplexed_model_id or None)
         resp = DeploymentResponse(self, replica_id, ref)
         resp._args, resp._kwargs = args, kwargs
         return resp
@@ -128,4 +149,4 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self._controller,
-                 self._method_name))
+                 self._method_name, self._multiplexed_model_id))

@@ -1,0 +1,123 @@
+"""Model multiplexing: many models per replica with LRU residency.
+
+Reference equivalent: `python/ray/serve/multiplex.py`
+(`_ModelMultiplexWrapper`) + `serve.get_multiplexed_model_id()` — the
+LLM-adapter pattern: one replica holds up to N loaded models (LoRA
+adapters, per-tenant heads); requests carry a model id; the router
+prefers replicas that already have that model warm.
+
+Usage:
+
+    @serve.deployment
+    class Adapters:
+        @serve.multiplexed(max_num_models_per_replica=3)
+        async def get_model(self, model_id: str):
+            return load_adapter(model_id)          # expensive
+
+        async def __call__(self, prompt):
+            model = await self.get_model(
+                serve.get_multiplexed_model_id())
+            return model(prompt)
+
+    handle.options(multiplexed_model_id="tenant-7").remote(prompt)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+_request_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default="")
+
+
+def get_multiplexed_model_id() -> str:
+    """The model id of the CURRENT request (empty when the request had
+    none). Reference: serve.get_multiplexed_model_id."""
+    return _request_model_id.get()
+
+
+def _set_request_model_id(model_id: str):
+    return _request_model_id.set(model_id)
+
+
+class _ModelMultiplexWrapper:
+    """Per-replica LRU of loaded models keyed by model id."""
+
+    def __init__(self, load_fn: Callable, owner: Any, max_models: int):
+        self._load_fn = load_fn
+        self._owner = owner
+        self._max = max(1, max_models)
+        self._models: "OrderedDict[str, Any]" = OrderedDict()
+        self._loading: dict = {}       # model_id -> Future (dedup)
+
+    @property
+    def model_ids(self):
+        return list(self._models.keys())
+
+    async def load(self, model_id: str) -> Any:
+        if model_id in self._models:
+            self._models.move_to_end(model_id)      # LRU touch
+            return self._models[model_id]
+        pending = self._loading.get(model_id)
+        if pending is not None:
+            return await asyncio.shield(pending)
+        fut = asyncio.get_running_loop().create_future()
+        self._loading[model_id] = fut
+        try:
+            model = self._load_fn(self._owner, model_id)
+            if asyncio.iscoroutine(model):
+                model = await model
+            while len(self._models) >= self._max:
+                evicted_id, evicted = self._models.popitem(last=False)
+                # Give the model a chance to free device memory NOW
+                # (reference: calls __del__ on eviction).
+                del evicted
+            self._models[model_id] = model
+            fut.set_result(model)
+            return model
+        except BaseException as e:
+            if not fut.done():
+                fut.set_exception(e)
+                try:
+                    fut.exception()   # mark retrieved
+                except Exception:
+                    pass
+            raise
+        finally:
+            self._loading.pop(model_id, None)
+
+
+def multiplexed(fn: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorator for the replica's model-loader method (reference:
+    serve.multiplexed). The wrapped method becomes an LRU-cached loader;
+    calling it with a model id returns the warm model."""
+
+    def wrap(load_fn: Callable):
+        attr = f"__serve_multiplex_{load_fn.__name__}"
+
+        async def loader(self, model_id: Optional[str] = None):
+            wrapper = getattr(self, attr, None)
+            if wrapper is None:
+                wrapper = _ModelMultiplexWrapper(
+                    load_fn, self, max_num_models_per_replica)
+                setattr(self, attr, wrapper)
+            if model_id is None:
+                model_id = get_multiplexed_model_id()
+            if not model_id:
+                raise ValueError(
+                    "no model id: pass one explicitly or set "
+                    "handle.options(multiplexed_model_id=...) on the "
+                    "request")
+            return await wrapper.load(model_id)
+
+        loader.__serve_multiplexed__ = True
+        loader.__name__ = load_fn.__name__
+        return loader
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap

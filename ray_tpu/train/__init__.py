@@ -17,10 +17,30 @@ from ray_tpu.air.session import (get_checkpoint, get_dataset_shard,
 from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig
 from ray_tpu.train.trainer import JaxTrainer, TrainingFailedError
 
+
+def get_mesh(shape=None, *, dp_across_slices: bool = True, devices=None):
+    """The gang's device mesh, topology-aware. Call from inside a
+    JaxTrainer train loop (after the backend ran jax.distributed
+    bootstrap). When the gang spans multiple TPU slices (or hosts) and
+    `dp_across_slices`, the mesh is hybrid: dp spans slices over DCN and
+    the model axes stay on ICI (`parallel/mesh.py make_hybrid_mesh`,
+    scaling-book layout). Single-slice gangs get the plain ICI mesh."""
+    import jax
+
+    from ray_tpu.parallel.mesh import (make_hybrid_mesh, make_mesh,
+                                       slice_id_of)
+
+    if devices is None:
+        devices = jax.devices()
+    if dp_across_slices and len({slice_id_of(d) for d in devices}) > 1:
+        return make_hybrid_mesh(shape, devices=devices)
+    return make_mesh(shape, devices=devices)
+
+
 __all__ = [
     "Backend", "BackendConfig", "Checkpoint", "CheckpointConfig",
     "FailureConfig", "JaxConfig", "JaxTrainer", "Result", "RunConfig",
-    "ScalingConfig", "TrainingFailedError", "session", "report",
-    "get_checkpoint", "get_dataset_shard", "get_local_rank",
+    "ScalingConfig", "TrainingFailedError", "get_mesh", "session",
+    "report", "get_checkpoint", "get_dataset_shard", "get_local_rank",
     "get_node_rank", "get_world_rank", "get_world_size",
 ]

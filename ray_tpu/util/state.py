@@ -71,6 +71,19 @@ def list_objects() -> List[Dict[str, Any]]:
     return rt.object_store_stats()
 
 
+def node_stats(address: str) -> Dict[str, Any]:
+    """One raylet's live stats (workers, leases, store, object-manager
+    flow control). Reference: `ray.util.state` node detail backed by
+    NodeManagerService.GetNodeStats."""
+    rt = _runtime()
+
+    async def _fetch():
+        client = await rt._raylet_client(address)
+        return await client.call("node_stats", timeout=30.0)
+
+    return rt._loop.run(_fetch(), timeout=30)
+
+
 def list_placement_groups() -> List[Dict[str, Any]]:
     from ray_tpu.util.placement_group import placement_group_table
 

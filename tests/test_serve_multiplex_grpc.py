@@ -1,0 +1,121 @@
+"""Serve model multiplexing + gRPC ingress.
+
+Reference coverage class: `python/ray/serve/tests/test_multiplex.py` and
+`test_grpc.py` — many models per replica behind an LRU, requests tagged
+with a model id, model-affinity routing, and a non-HTTP ingress.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture()
+def serve_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ray_tpu
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@serve.deployment
+class Adapters:
+    """Multiplexed deployment: tracks every model load per replica."""
+
+    def __init__(self):
+        self.loads = []
+
+    @serve.multiplexed(max_num_models_per_replica=2)
+    async def get_model(self, model_id: str):
+        self.loads.append(model_id)
+        return {"id": model_id, "scale": len(model_id)}
+
+    async def __call__(self, x):
+        model = await self.get_model(serve.get_multiplexed_model_id())
+        return {"model": model["id"], "y": x * model["scale"],
+                "loads": list(self.loads)}
+
+
+def test_multiplexed_loading_and_lru(serve_cluster):
+    handle = serve.run(Adapters.bind(), name="adapters")
+    h1 = handle.options(multiplexed_model_id="aa")
+
+    out = h1.remote(3).result(timeout_s=60)
+    assert out == {"model": "aa", "y": 6, "loads": ["aa"]}
+    # Warm hit: no second load of "aa".
+    out = h1.remote(4).result(timeout_s=60)
+    assert out["loads"] == ["aa"]
+
+    # Second model coexists (cap 2).
+    out = handle.options(multiplexed_model_id="bbbb").remote(
+        2).result(timeout_s=60)
+    assert out["model"] == "bbbb" and out["y"] == 8
+    assert out["loads"] == ["aa", "bbbb"]
+
+    # Third model evicts the LRU ("aa"); re-requesting "aa" re-loads.
+    handle.options(multiplexed_model_id="cc").remote(1).result(
+        timeout_s=60)
+    out = h1.remote(1).result(timeout_s=60)
+    assert out["loads"].count("aa") == 2, out["loads"]
+
+
+def test_missing_model_id_is_typed_error(serve_cluster):
+    handle = serve.run(Adapters.bind(), name="adapters2")
+    with pytest.raises(Exception, match="model id"):
+        handle.remote(1).result(timeout_s=60)
+
+
+@serve.deployment(num_replicas=2)
+class Affinity:
+    def __init__(self):
+        import os
+
+        self.pid = os.getpid()
+        self.loaded = []
+
+    @serve.multiplexed(max_num_models_per_replica=4)
+    async def get_model(self, model_id: str):
+        self.loaded.append(model_id)
+        return model_id
+
+    async def __call__(self, _):
+        await self.get_model(serve.get_multiplexed_model_id())
+        return {"pid": self.pid, "loaded": list(self.loaded)}
+
+
+def test_model_affinity_routes_to_warm_replica(serve_cluster):
+    handle = serve.run(Affinity.bind(), name="affinity")
+    h = handle.options(multiplexed_model_id="m1")
+    pids = {h.remote(0).result(timeout_s=60)["pid"] for _ in range(10)}
+    # All 10 requests for one model land on ONE replica of the two.
+    assert len(pids) == 1, f"model m1 bounced across replicas: {pids}"
+
+
+def test_grpc_ingress_end_to_end(serve_cluster):
+    @serve.deployment
+    class Echo:
+        async def __call__(self, x, mult=1):
+            return {"x": x * mult}
+
+        async def tagged(self, x):
+            return {"tag": serve.get_multiplexed_model_id(), "x": x}
+
+    serve.run(Echo.bind(), name="echo")
+    port = serve.start_grpc_ingress()
+    assert port > 0
+    # Idempotent: same port on a second start.
+    assert serve.start_grpc_ingress() == port
+
+    client = serve.GrpcServeClient(f"127.0.0.1:{port}")
+    try:
+        # Target = deployment name (the gRPC analogue of the HTTP route).
+        assert client.call("Echo", 21, mult=2) == {"x": 42}
+        out = client.call("Echo", 5, method="tagged", model_id="mx")
+        assert out == {"tag": "mx", "x": 5}
+        with pytest.raises(serve.RayServeException, match="no target"):
+            client.call("", 1)
+    finally:
+        client.close()
