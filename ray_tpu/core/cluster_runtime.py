@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextlib
 import logging
 import os
 import threading
@@ -89,6 +90,7 @@ class _ActorState:
         self.state = "PENDING"
         self.client: Optional[RpcClient] = None
         self.restarts_remaining = 0
+        self.task_retries = 0     # max_task_retries (system failures)
         self.creation: Optional[dict] = None  # for owner-led restart
         self.lock = None  # asyncio.Lock, created lazily on the loop
         self.alive_event: Optional[object] = None
@@ -1017,6 +1019,14 @@ class ClusterRuntime:
                     opts, "placement_group_bundle_index", -1),
             }),
         )
+        from ray_tpu.util.tracing import (current_traceparent,
+                                          tracing_enabled)
+
+        if tracing_enabled():
+            # Propagate the caller's span so the worker-side execution
+            # span parents across the process boundary
+            # (reference: tracing_helper._inject_tracing_into_function).
+            spec.trace_ctx = current_traceparent()
         refs = self._make_return_refs(task_id, num_returns)
         gen = None
         if streaming:
@@ -1609,6 +1619,7 @@ class ClusterRuntime:
             "owner": self.address,
             "state": "PENDING",
             "max_restarts": opts.max_restarts,
+            "max_task_retries": opts.max_task_retries,
             "job_id": self.job_id.hex(),
             "detached": detached,
             "method_meta": {k: {kk: vv for kk, vv in m.items()}
@@ -1620,6 +1631,7 @@ class ClusterRuntime:
 
         state = _ActorState(aid)
         state.restarts_remaining = opts.max_restarts
+        state.task_retries = opts.max_task_retries
         args_blob, pinned = self._serialize_args(args, kwargs)
         state.creation = {
             "cls_key": cls_key,
@@ -1748,6 +1760,11 @@ class ClusterRuntime:
             concurrency_group=(handle._method_meta or {}).get(
                 method_name, {}).get("concurrency_group"),
         )
+        from ray_tpu.util.tracing import (current_traceparent,
+                                          tracing_enabled)
+
+        if tracing_enabled():
+            spec.trace_ctx = current_traceparent()
         refs = self._make_return_refs(task_id, num_returns)
         self._record_task_event(task_id.hex(), spec["name"], "SUBMITTED",
                                 actor_id=aid)
@@ -1775,6 +1792,8 @@ class ClusterRuntime:
                 if info["state"] == "ALIVE":
                     if state is None:
                         state = _ActorState(aid)
+                        state.task_retries = info.get(
+                            "max_task_retries", 0) or 0
                         self._actors[aid] = state
                     state.address = info["address"]
                     state.state = "ALIVE"
@@ -2507,7 +2526,19 @@ class ClusterRuntime:
                 apply_runtime_env(self, spec["runtime_env"])
             fn = self._fn.fetch(spec["fn_key"])
             args, kwargs, arg_refs = self._resolve_task_args(spec["args"])
-            value = fn(*args, **kwargs)
+            from ray_tpu.util.tracing import span, tracing_enabled
+
+            if tracing_enabled() or spec.get("trace_ctx"):
+                # Execution span parents to the CALLER's span via the
+                # propagated traceparent (reference: tracing_helper's
+                # _function_span on the worker side).
+                with span(f"task.run {name}",
+                          parent=spec.get("trace_ctx"),
+                          attributes={"task_id": task_id,
+                                      "component": "worker"}):
+                    value = fn(*args, **kwargs)
+            else:
+                value = fn(*args, **kwargs)
             args = kwargs = None
             results = self._package_returns(task_id, num_returns, name,
                                             value)
@@ -2742,15 +2773,25 @@ class ClusterRuntime:
                 raise TaskCancelledError(task_id)
             self._ensure_job_env(spec.get("job_id"))
             args, kwargs, arg_refs = self._resolve_task_args(spec["args"])
-            if spec["method"] == "__ray_call__":
-                # fn(actor_instance, *args): the system method for running
-                # arbitrary code against a live actor (reference:
-                # __ray_call__ in python/ray/actor.py).
-                fn, args = args[0], args[1:]
-                value = fn(self._actor_instance, *args, **kwargs)
-            else:
-                method = getattr(self._actor_instance, spec["method"])
-                value = method(*args, **kwargs)
+            from ray_tpu.util.tracing import span, tracing_enabled
+
+            traced = tracing_enabled() or spec.get("trace_ctx")
+            ctx = (span(f"actor.run {name}",
+                        parent=spec.get("trace_ctx"),
+                        attributes={"task_id": task_id,
+                                    "actor_id": spec.get("actor_id"),
+                                    "component": "worker"})
+                   if traced else contextlib.nullcontext())
+            with ctx:
+                if spec["method"] == "__ray_call__":
+                    # fn(actor_instance, *args): the system method for
+                    # running arbitrary code against a live actor
+                    # (reference: __ray_call__ in python/ray/actor.py).
+                    fn, args = args[0], args[1:]
+                    value = fn(self._actor_instance, *args, **kwargs)
+                else:
+                    method = getattr(self._actor_instance, spec["method"])
+                    value = method(*args, **kwargs)
             if _inspect.iscoroutine(value):
                 cfut = asyncio.run_coroutine_threadsafe(
                     value, self._actor_loop)

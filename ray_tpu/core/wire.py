@@ -244,6 +244,7 @@ class TaskSpec:
     runtime_env: Optional[dict] = None
     pg: Optional[dict] = None          # {pg_id, bundle_index}
     visible_chips: Optional[list] = None
+    trace_ctx: Optional[str] = None    # W3C traceparent (util/tracing.py)
 
 
 @wire_message("ActorTaskSpec", version=1)
@@ -261,6 +262,7 @@ class ActorTaskSpec:
     owner: Optional[str] = None
     streaming: bool = False
     concurrency_group: Optional[str] = None
+    trace_ctx: Optional[str] = None    # W3C traceparent (util/tracing.py)
 
 
 @wire_message("LeaseRequest", version=1)
@@ -320,6 +322,7 @@ class ActorInfo:
     owner: Optional[str] = None
     class_name: Optional[str] = None
     max_restarts: int = 0
+    max_task_retries: int = 0
     num_restarts: int = 0
     detached: bool = False
     death_cause: Optional[str] = None

@@ -10,9 +10,10 @@ from ray_tpu.data.block import Block
 from ray_tpu.data.dataset import (Dataset, GroupedData, from_blocks,
                                   from_items, from_numpy, from_pandas,
                                   range, read_csv, read_json, read_parquet)
+from ray_tpu.data.dataset_pipeline import DatasetPipeline
 
 __all__ = [
-    "Block", "Dataset", "GroupedData", "range", "from_blocks",
-    "from_items", "from_numpy", "from_pandas", "read_csv", "read_json",
-    "read_parquet",
+    "Block", "Dataset", "DatasetPipeline", "GroupedData", "range",
+    "from_blocks", "from_items", "from_numpy", "from_pandas", "read_csv",
+    "read_json", "read_parquet",
 ]

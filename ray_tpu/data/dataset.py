@@ -35,6 +35,7 @@ class Dataset:
     _limit: Optional[int] = None
     _actor_stage: Optional[Any] = None        # compute="actors" stage
     _post_transforms: List[Callable] = []     # applied after the stage
+    _zip_with: Optional["Dataset"] = None     # row-aligned zip partner
 
     def _check_not_limited(self, op: str) -> None:
         if self._limit is not None:
@@ -209,12 +210,117 @@ class Dataset:
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
 
+    def window(self, *, blocks_per_window: int = 8):
+        """Convert to a DatasetPipeline of `blocks_per_window`-block
+        windows executing one window at a time (reference:
+        Dataset.window) — bounds working-set memory for datasets larger
+        than the object store."""
+        self._check_not_limited("window")
+        from ray_tpu.data.dataset_pipeline import DatasetPipeline
+
+        return DatasetPipeline.from_dataset(self, blocks_per_window)
+
+    def repeat(self, times: Optional[int] = None):
+        """Multi-epoch pipeline over this dataset (reference:
+        Dataset.repeat)."""
+        self._check_not_limited("repeat")
+        from ray_tpu.data.dataset_pipeline import DatasetPipeline
+
+        return DatasetPipeline.from_dataset(
+            self, blocks_per_window=max(1, len(self._read_tasks))
+        ).repeat(times)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of two row-aligned datasets (reference:
+        Dataset.zip): row i of the result has the columns of both inputs'
+        row i (name clashes get an `_1` suffix). Streaming: both sides
+        iterate with row-aligned rebatching; neither fully materializes.
+        Raises at iteration if the row counts differ."""
+        self._check_not_limited("zip")
+        other._check_not_limited("zip")
+        ds = Dataset(self._read_tasks, self._transforms, self._block_refs)
+        ds._actor_stage = self._actor_stage
+        ds._post_transforms = self._post_transforms
+        ds._zip_with = other
+        return ds
+
+    def _iter_zipped(self, max_in_flight: int) -> Iterator[Block]:
+        left = self._unzipped_blocks(max_in_flight)
+        right = self._zip_with.iter_blocks(max_in_flight)
+        lbuf: Optional[Block] = None
+        rbuf: Optional[Block] = None
+        while True:
+            if lbuf is None or block_num_rows(lbuf) == 0:
+                lbuf = next(left, None)
+            if rbuf is None or block_num_rows(rbuf) == 0:
+                rbuf = next(right, None)
+            if lbuf is None or rbuf is None:
+                break
+            n = min(block_num_rows(lbuf), block_num_rows(rbuf))
+            lcut = block_slice(lbuf, 0, n)
+            rcut = block_slice(rbuf, 0, n)
+            out = dict(lcut)
+            for c, v in rcut.items():
+                out[c if c not in out else f"{c}_1"] = v
+            yield out
+            lbuf = block_slice(lbuf, n, block_num_rows(lbuf))
+            rbuf = block_slice(rbuf, n, block_num_rows(rbuf))
+        lrest = (block_num_rows(lbuf) if lbuf else 0) + sum(
+            block_num_rows(b) for b in left)
+        rrest = (block_num_rows(rbuf) if rbuf else 0) + sum(
+            block_num_rows(b) for b in right)
+        if lrest or rrest:
+            raise ValueError(
+                f"zip(): datasets have different row counts "
+                f"(+{lrest} left / +{rrest} right after alignment)")
+
+    def join(self, other: "Dataset", on: str, how: str = "inner",
+             *, num_partitions: Optional[int] = None) -> "Dataset":
+        """Hash join on a key column (reference: Dataset.join): both
+        sides hash-partition on `on`, each partition pair merges — a
+        distributed task exchange when a cluster is up (driver holds only
+        refs), an in-process pandas merge otherwise."""
+        self._check_not_limited("join")
+        other._check_not_limited("join")
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"unsupported join how={how!r}")
+        import ray_tpu
+
+        if ray_tpu.is_initialized():
+            from ray_tpu.data.shuffle import (block_ref_reader,
+                                              distributed_join)
+
+            parts = num_partitions or max(1, len(self._read_tasks))
+            refs = distributed_join(
+                self._read_tasks, self._transforms,
+                other._read_tasks, other._transforms, on, how, parts)
+            return Dataset([block_ref_reader(r) for r in refs],
+                           block_refs=refs)
+        import pandas as pd
+
+        ldf = pd.DataFrame(self.materialize())
+        rdf = pd.DataFrame(other.materialize())
+        out = ldf.merge(rdf, on=on, how=how, suffixes=("", "_1"))
+        block = {c: out[c].to_numpy() for c in out.columns}
+        return Dataset([lambda: block])
+
     # -- execution ------------------------------------------------------
     def _executor(self, max_in_flight: int = 4) -> StreamingExecutor:
         return StreamingExecutor(self._read_tasks, self._transforms,
                                  max_in_flight=max_in_flight)
 
     def iter_blocks(self, max_in_flight: int = 4) -> Iterator[Block]:
+        if self._zip_with is not None:
+            blocks = self._iter_zipped(max_in_flight)
+            if self._limit is None:
+                return blocks
+            return self._limited(blocks, self._limit)
+        blocks = self._unzipped_blocks(max_in_flight)
+        if self._limit is None:
+            return blocks
+        return self._limited(blocks, self._limit)
+
+    def _unzipped_blocks(self, max_in_flight: int = 4) -> Iterator[Block]:
         import ray_tpu
 
         if self._actor_stage is not None:
@@ -248,9 +354,7 @@ class Dataset:
             ex = self._executor(max_in_flight)
             blocks = (iter(ex) if ray_tpu.is_initialized()
                       else ex.run_local())
-        if self._limit is None:
-            return blocks
-        return self._limited(blocks, self._limit)
+        return blocks
 
     def _iter_block_refs(self) -> Iterator[Block]:
         import threading

@@ -177,6 +177,24 @@ def shuffle_reduce(finalize, part_index: int, *parts):
     return finalize(block, part_index)
 
 
+def join_reduce(on: str, how: str, n_left: int, part_index: int, *parts):
+    """Merge partition j of both sides (hash-partitioned on `on`): a
+    pandas merge per partition — the standard partitioned hash join
+    (reference: Dataset.join's hash-shuffle + per-partition merge)."""
+    import pandas as pd
+
+    left = [p for p in parts[:n_left] if p and block_num_rows(p)]
+    right = [p for p in parts[n_left:] if p and block_num_rows(p)]
+    lb = concat_blocks(left) if left else {}
+    rb = concat_blocks(right) if right else {}
+    if not lb and not rb:
+        return {}
+    ldf = pd.DataFrame(lb if lb else {on: []})
+    rdf = pd.DataFrame(rb if rb else {on: []})
+    out = ldf.merge(rdf, on=on, how=how, suffixes=("", "_1"))
+    return {c: out[c].to_numpy() for c in out.columns}
+
+
 def bake_block(read_task, transforms):
     """Materialize one chain output into the object store (sort's extra
     pass: sampling must not re-run the chain)."""
@@ -221,6 +239,32 @@ def _exchange(sources: List[Any], transforms, partitioner, finalize,
         parts = ([refs[j] for refs in map_out] if num_parts > 1
                  else list(map_out))
         out.append(reducer.remote(finalize, j, *parts))
+    return out
+
+
+def distributed_join(left_tasks, left_transforms, right_tasks,
+                     right_transforms, on: str, how: str,
+                     num_parts: int) -> List[Any]:
+    """Two-sided hash exchange: both datasets partition on the join key,
+    reducer j merges partition j of each side. Driver holds only refs."""
+    import ray_tpu
+
+    part = HashPartitioner(on, num_parts)
+    mapper = ray_tpu.remote(num_cpus=1, num_returns=num_parts)(shuffle_map)
+    reducer = ray_tpu.remote(num_cpus=1)(join_reduce)
+    map_l = [mapper.remote(src, left_transforms, part, num_parts, i)
+             for i, src in enumerate(left_tasks)]
+    map_r = [mapper.remote(src, right_transforms, part, num_parts,
+                           1000 + i)
+             for i, src in enumerate(right_tasks)]
+    out = []
+    for j in range(num_parts):
+        lparts = ([refs[j] for refs in map_l] if num_parts > 1
+                  else list(map_l))
+        rparts = ([refs[j] for refs in map_r] if num_parts > 1
+                  else list(map_r))
+        out.append(reducer.remote(on, how, len(lparts), j,
+                                  *lparts, *rparts))
     return out
 
 

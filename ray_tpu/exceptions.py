@@ -80,7 +80,15 @@ class RayActorError(RayError):
 
     def __init__(self, actor_id=None, error_msg: str = "The actor died."):
         self.actor_id = actor_id
+        self.error_msg = error_msg
         super().__init__(error_msg)
+
+    def __reduce__(self):
+        # Default Exception pickling replays __init__ with self.args =
+        # (error_msg,) — which would land the MESSAGE in the actor_id
+        # slot and reset the message to the default, destroying the
+        # diagnostic the moment the error crosses a process boundary.
+        return (type(self), (self.actor_id, self.error_msg))
 
 
 class ActorDiedError(RayActorError):

@@ -1,0 +1,143 @@
+"""Dataset zip/join/window/repeat.
+
+Reference coverage class: `python/ray/data/tests/test_zip.py`,
+`test_join.py` (hash join), `test_pipeline.py` (DatasetPipeline
+window/repeat semantics).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+@pytest.fixture()
+def ray_cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+# -- zip (no cluster needed: streaming row alignment) -------------------
+
+class TestZip:
+    def test_zip_aligns_rows_across_block_boundaries(self):
+        a = rdata.range(100, parallelism=4).map_batches(
+            lambda b: {"x": b["id"]})
+        b = rdata.range(100, parallelism=7).map_batches(
+            lambda b: {"y": b["id"] * 2})
+        z = a.zip(b)
+        rows = z.take_all()
+        assert len(rows) == 100
+        assert all(r["y"] == 2 * r["x"] for r in rows)
+
+    def test_zip_name_clash_suffixes(self):
+        a = rdata.range(10)
+        b = rdata.range(10)
+        rows = a.zip(b).take_all()
+        assert set(rows[0].keys()) == {"id", "id_1"}
+
+    def test_zip_mismatched_lengths_raise(self):
+        a = rdata.range(10)
+        b = rdata.range(12)
+        with pytest.raises(ValueError, match="different row counts"):
+            a.zip(b).take_all()
+
+    def test_zip_then_map(self):
+        a = rdata.range(20).map_batches(lambda b: {"x": b["id"]})
+        b = rdata.range(20).map_batches(lambda b: {"y": b["id"] + 1})
+        total = sum(r["x"] + r["y"] for r in a.zip(b).iter_rows())
+        assert total == sum(i + i + 1 for i in range(20))
+
+
+# -- join ----------------------------------------------------------------
+
+def _left():
+    return rdata.from_numpy(
+        {"k": np.array([1, 2, 3, 4, 5]),
+         "a": np.array([10, 20, 30, 40, 50])}, parallelism=2)
+
+
+def _right():
+    return rdata.from_numpy(
+        {"k": np.array([2, 4, 6]),
+         "b": np.array([200, 400, 600])}, parallelism=2)
+
+
+class TestJoinLocal:
+    def test_inner_join(self):
+        rows = sorted(_left().join(_right(), on="k").take_all(),
+                      key=lambda r: r["k"])
+        assert [(r["k"], r["a"], r["b"]) for r in rows] == \
+            [(2, 20, 200), (4, 40, 400)]
+
+    def test_left_join(self):
+        rows = sorted(_left().join(_right(), on="k", how="left")
+                      .take_all(), key=lambda r: r["k"])
+        assert len(rows) == 5
+        joined = {r["k"]: r["b"] for r in rows}
+        assert joined[2] == 200 and np.isnan(joined[1])
+
+    def test_bad_how_rejected(self):
+        with pytest.raises(ValueError, match="how"):
+            _left().join(_right(), on="k", how="cross")
+
+
+@pytest.mark.cluster
+def test_distributed_join_matches_local(ray_cluster):
+    rng = np.random.default_rng(0)
+    lk = rng.integers(0, 50, 300)
+    rk = rng.integers(0, 50, 200)
+    left = rdata.from_numpy({"k": lk, "a": np.arange(300)},
+                            parallelism=4)
+    right = rdata.from_numpy({"k": rk, "b": np.arange(200) * 10},
+                             parallelism=3)
+    rows = left.join(right, on="k").take_all()
+
+    import pandas as pd
+
+    want = pd.DataFrame({"k": lk, "a": np.arange(300)}).merge(
+        pd.DataFrame({"k": rk, "b": np.arange(200) * 10}), on="k")
+    assert len(rows) == len(want)
+    got = sorted((r["k"], r["a"], r["b"]) for r in rows)
+    expect = sorted(zip(want["k"], want["a"], want["b"]))
+    assert got == expect
+
+
+# -- DatasetPipeline -----------------------------------------------------
+
+class TestPipeline:
+    def test_window_bounds_and_order(self):
+        ds = rdata.range(64, parallelism=8)
+        pipe = ds.window(blocks_per_window=2)
+        assert pipe.num_windows == 4
+        ids = [r["id"] for r in pipe.iter_rows()]
+        assert ids == list(range(64))
+
+    def test_repeat_epochs(self):
+        pipe = rdata.range(10, parallelism=2).repeat(3)
+        assert pipe.count() == 30
+        epochs = list(pipe.iter_epochs())
+        assert len(epochs) == 3
+        assert [r["id"] for r in epochs[0].iter_rows()] == list(range(10))
+
+    def test_per_window_transform(self):
+        pipe = (rdata.range(16, parallelism=4)
+                .window(blocks_per_window=2)
+                .map_batches(lambda b: {"id": b["id"] * 10}))
+        assert [r["id"] for r in pipe.iter_rows()] == \
+            [i * 10 for i in range(16)]
+
+    def test_iter_batches_and_take(self):
+        pipe = rdata.range(40, parallelism=4).window(blocks_per_window=1)
+        batches = list(pipe.iter_batches(batch_size=16))
+        assert sum(len(b["id"]) for b in batches) == 40
+        assert [r["id"] for r in pipe.take(5)] == [0, 1, 2, 3, 4]
+
+    def test_infinite_repeat_take(self):
+        pipe = rdata.range(4, parallelism=1).repeat(None)
+        assert [r["id"] for r in pipe.take(10)] == \
+            [0, 1, 2, 3, 0, 1, 2, 3, 0, 1]
+        with pytest.raises(ValueError, match="infinite"):
+            pipe.count()
