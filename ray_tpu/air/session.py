@@ -5,18 +5,114 @@ Reference: `python/ray/air/session.py` + `train/_internal/session.py:109,393`
 The session lives in the training worker process; `report()` hands a result
 to the executor and blocks until it is consumed, giving the gang natural
 lockstep at report boundaries.
+
+Step telemetry: each `report()` closes one "step" whose wall time is
+split into data-wait (time blocked in the instrumented dataset-shard
+iterators), collective time (recorded by `util/collective.py` ops), and
+compute (the remainder). The split rides the report as `telemetry`
+metadata for the backend executor AND lands in worker-local
+`train_*_seconds` histograms, which the metrics push exports to the
+dashboard's /metrics (reference: ray.train's per-step reporting +
+metrics agent export).
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, Iterator, Optional
 
 from ray_tpu.air.checkpoint import Checkpoint
 
 _session_lock = threading.Lock()
 _session: Optional["_TrainSession"] = None
+
+_TELEMETRY_KINDS = ("step_time", "data_wait", "collective", "compute")
+
+
+def _train_histograms() -> Dict[str, Any]:
+    """Lazy per-process train_* histograms (created in the worker, so
+    registration lands in the worker's pushed registry)."""
+    from ray_tpu.util.metrics import Histogram, get_instruments
+
+    def build():
+        bounds = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                  60.0]
+        return {
+            kind: Histogram(
+                f"train_{kind}_seconds",
+                f"Per-training-step {kind.replace('_', ' ')} (seconds)",
+                boundaries=bounds, tag_keys=("trial",))
+            for kind in _TELEMETRY_KINDS
+        }
+
+    return get_instruments("train.session", build)
+
+
+def _record_collective(seconds: float) -> None:
+    """Called by util/collective.py ops: attribute collective wall time
+    to the active training step (no-op outside a train loop)."""
+    s = _get_session(required=False)
+    if s is not None:
+        s._collective_s += seconds
+
+
+class _TimedIter:
+    """Iterator wrapper charging next() wall time to the session's
+    data-wait bucket (reference: ray.train's instrumented dataset
+    iterator feeding `data_wait` in step telemetry)."""
+
+    def __init__(self, it: Iterator, session: "_TrainSession"):
+        self._it = iter(it)
+        self._session = session
+
+    def __iter__(self) -> "_TimedIter":
+        return self
+
+    def __next__(self):
+        t0 = time.perf_counter()
+        try:
+            return next(self._it)
+        finally:
+            self._session._data_wait_s += time.perf_counter() - t0
+
+
+class _TimedShard:
+    """Transparent dataset-shard proxy: any `iter_*` call returns a
+    timed iterator; everything else delegates to the real shard.
+
+    Pickling unwraps to the underlying shard (the session holds locks
+    and queues): a train loop that ships its shard into a remote task
+    keeps working, it just isn't timed on the other side."""
+
+    def __init__(self, shard: Any, session: "_TrainSession"):
+        self._shard = shard
+        self._session = session
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._shard, name)
+        if name.startswith("iter_") and callable(attr):
+            session = self._session
+
+            def timed(*args, **kwargs):
+                return _TimedIter(attr(*args, **kwargs), session)
+
+            return timed
+        return attr
+
+    def __iter__(self):
+        return _TimedIter(iter(self._shard), self._session)
+
+    def __reduce__(self):
+        return (_identity, (self._shard,))
+
+    def __repr__(self) -> str:
+        return f"TimedShard({self._shard!r})"
+
+
+def _identity(x):
+    return x
 
 
 class _TrainSession:
@@ -39,13 +135,45 @@ class _TrainSession:
         self.error: Optional[BaseException] = None
         self.final_return: Any = None
         self.stop_requested = False
+        # -- step telemetry (reset at each report boundary) -------------
+        self._step_t0 = time.perf_counter()
+        self._data_wait_s = 0.0
+        self._collective_s = 0.0
+        self.last_telemetry: Optional[Dict[str, float]] = None
+
+    def _close_step(self) -> Dict[str, float]:
+        step_wall = max(0.0, time.perf_counter() - self._step_t0)
+        data_wait = min(self._data_wait_s, step_wall)
+        collective = min(self._collective_s, step_wall - data_wait)
+        telemetry = {
+            "step_time_s": step_wall,
+            "data_wait_s": data_wait,
+            "collective_s": collective,
+            "compute_s": max(0.0, step_wall - data_wait - collective),
+            "world_rank": self.world_rank,
+        }
+        self.last_telemetry = telemetry
+        try:
+            hists = _train_histograms()
+            tags = {"trial": self.trial_name or "default"}
+            for kind in _TELEMETRY_KINDS:
+                hists[kind].observe(telemetry[f"{kind}_s"], tags=tags)
+        except Exception:
+            pass  # telemetry must never fail a training step
+        self._data_wait_s = 0.0
+        self._collective_s = 0.0
+        return telemetry
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
+        telemetry = self._close_step()
         self.result_queue.put({"type": "report", "metrics": dict(metrics),
-                               "checkpoint": checkpoint})
+                               "checkpoint": checkpoint,
+                               "telemetry": telemetry})
         self.continue_event.wait()
         self.continue_event.clear()
+        # The next step starts when the executor releases this report.
+        self._step_t0 = time.perf_counter()
         if self.stop_requested:
             raise _StopTraining()
 
@@ -109,7 +237,15 @@ def get_trial_name() -> str:
 
 
 def get_dataset_shard(name: str = "train") -> Any:
-    shard = _get_session().dataset_shard
+    """The worker's dataset shard, wrapped in a timing proxy (like the
+    reference's DataIterator wrapper): blocked-on-data time feeds the
+    step's data_wait telemetry split. The proxy delegates every
+    attribute to the real shard and unwraps on pickle, but is not an
+    `isinstance` match for Dataset/DatasetPipeline — duck-type it."""
+    session = _get_session()
+    shard = session.dataset_shard
     if isinstance(shard, dict):
-        return shard.get(name)
-    return shard
+        shard = shard.get(name)
+    if shard is None:
+        return None
+    return _TimedShard(shard, session)

@@ -222,12 +222,28 @@ class GcsServer:
                     "GCS snapshot at %s unreadable; starting from WAL only",
                     self._storage_path, exc_info=True)
         # Replay the delta log over the snapshot. A torn tail (crash mid
-        # append) ends the replay at the last complete frame.
+        # append) ends the replay at the last complete frame — and the
+        # file MUST then be truncated to that frame before _append_wal
+        # reopens it in append mode: new fsynced+acked frames written
+        # after a surviving partial frame would be unreachable to every
+        # future replay (ADVICE r5 high: acked writes silently dropped
+        # on the second restart).
         wal = self._wal_path()
         if os.path.exists(wal):
             with open(wal, "rb") as f:
-                replayed = self._replay_frames(f, torn_ok=True)
-            self._wal_size = os.path.getsize(wal)
+                replayed, clean_end = self._replay_frames(f, torn_ok=True)
+            wal_size = os.path.getsize(wal)
+            if clean_end < wal_size:
+                logger.warning(
+                    "GCS WAL has a torn tail (%d of %d bytes replayable);"
+                    " truncating before accepting new appends",
+                    clean_end, wal_size)
+                with open(wal, "r+b") as f:
+                    f.truncate(clean_end)
+                    f.flush()
+                    os.fsync(f.fileno())
+                wal_size = clean_end
+            self._wal_size = wal_size
             if replayed:
                 logger.info("GCS replayed %d WAL batches", replayed)
         # Recovered actor records point at pre-restart workers; their
@@ -250,14 +266,17 @@ class GcsServer:
             except Exception:
                 pass  # stays dirty; retried next tick
 
-    def _replay_frames(self, f, torn_ok: bool) -> int:
+    def _replay_frames(self, f, torn_ok: bool):
         """Apply length-prefixed record batches from an open file. A torn
         tail (crash mid-append) ends a WAL replay at the last complete
-        frame; in a snapshot it means corruption, so raise."""
+        frame; in a snapshot it means corruption, so raise. Returns
+        (frames_applied, offset_after_last_complete_frame) — the offset
+        is what a WAL load truncates to."""
         import pickle
         import struct
 
         replayed = 0
+        clean_end = f.tell()
         while True:
             hdr = f.read(4)
             if not hdr:
@@ -287,7 +306,8 @@ class GcsServer:
                 else:
                     tbl.pop(key, None)
             replayed += 1
-        return replayed
+            clean_end = f.tell()
+        return replayed, clean_end
 
     def _wal_path(self) -> str:
         return f"{self._storage_path}.wal"

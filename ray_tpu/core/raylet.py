@@ -110,30 +110,58 @@ class _PullManager:
         import heapq
 
         size = min(int(size), self.budget)
+        # Purge cancelled waiters first: with nothing in flight there is
+        # no future release() to sweep them, and a live heap of only
+        # dead entries must not push new admits onto the queue forever.
+        while self._waiters and not self._waiters[0][3][0]:
+            heapq.heappop(self._waiters)
         if not self._waiters and self.in_use + size <= self.budget:
             self.in_use += size
         else:
             ev = asyncio.Event()
+            # Mutable liveness flag: a cancelled waiter marks itself
+            # dead so the wake loop skips it WITHOUT charging in_use —
+            # a leaked charge here permanently shrinks the pull budget
+            # (ADVICE r5 low).
+            entry = (size, self._seq + 1, ev, [True])
             self._seq += 1
-            heapq.heappush(self._waiters, (size, self._seq, ev))
+            heapq.heappush(self._waiters, entry)
             self.stats["queued"] += 1
-            await ev.wait()
+            try:
+                await ev.wait()
+            except asyncio.CancelledError:
+                if ev.is_set():
+                    # Granted between the wake and this resumption: the
+                    # bytes were already charged — return them (and wake
+                    # anyone they now fit).
+                    self._return_bytes(size)
+                else:
+                    entry[3][0] = False  # still queued: mark dead
+                raise
         self.stats["admitted"] += 1
         self.stats["active"] += 1
         self.stats["peak_bytes"] = max(self.stats["peak_bytes"],
                                        self.in_use)
         return size
 
-    def release(self, size: int) -> None:
+    def _return_bytes(self, size: int) -> None:
         import heapq
 
         self.in_use -= size
-        self.stats["active"] -= 1
-        while self._waiters and \
-                self.in_use + self._waiters[0][0] <= self.budget:
-            wsize, _, ev = heapq.heappop(self._waiters)
+        while self._waiters:
+            wsize, _, ev, alive = self._waiters[0]
+            if not alive[0]:
+                heapq.heappop(self._waiters)  # cancelled: drop, no charge
+                continue
+            if self.in_use + wsize > self.budget:
+                break
+            heapq.heappop(self._waiters)
             self.in_use += wsize
             ev.set()
+
+    def release(self, size: int) -> None:
+        self.stats["active"] -= 1
+        self._return_bytes(size)
 
 
 class Raylet:

@@ -15,6 +15,8 @@ Endpoints:
   GET /api/objects             per-node object-store inventories
   GET /api/cluster_status      resource totals/availability summary
   GET /api/tasks?job_id=...    task events
+  GET /api/serve               per-deployment QPS/latency/queue state
+  GET /api/train               per-trial step-time telemetry
   GET /metrics                 Prometheus text: all nodes + app metrics
   GET /                        tiny HTML index
 
@@ -42,6 +44,8 @@ _INDEX_HTML = """<!doctype html>
 <li><a href=/api/placement_groups>placement groups</a>
 <li><a href=/api/objects>objects</a>
 <li><a href=/api/cluster_status>cluster status</a>
+<li><a href=/api/serve>serve deployments</a>
+<li><a href=/api/train>train telemetry</a>
 <li><a href=/metrics>metrics (prometheus)</a>
 </ul>
 """
@@ -153,6 +157,10 @@ class DashboardHead:
         if endpoint == "tasks":
             job = query.get("job_id", [None])[0]
             return await self._gcs.get_task_events(job_id=job)
+        if endpoint == "serve":
+            return await self._serve_state()
+        if endpoint == "train":
+            return await self._train_state()
         return None
 
     async def _raylet(self, address: str):
@@ -208,6 +216,115 @@ class DashboardHead:
         return {"nodes_alive": alive, "nodes_total": len(nodes),
                 "resources_total": totals,
                 "resources_available": available}
+
+    # -- workload views (tentpole: aggregate the live serve_*/train_*
+    # series every node pushes into per-deployment / per-trial JSON the
+    # frontend-to-be would chart; reference: Serve's and Train's
+    # dashboard panes over the same Prometheus series) -----------------
+    async def _workload_snapshot(self, prefix: str):
+        merged: Dict[str, Any] = {}
+        for snaps in await self._per_node("get_metrics"):
+            if not isinstance(snaps, list):
+                continue  # dict = scrape error
+            for m in snaps:
+                if m["name"].startswith(prefix):
+                    merged.setdefault(m["name"], []).extend(
+                        m.get("samples", []))
+        return merged
+
+    @staticmethod
+    def _sum_by(samples, tag: str, *, field: str = "value"):
+        out: Dict[str, float] = {}
+        for s in samples:
+            key = s.get("tags", {}).get(tag, "?")
+            out[key] = out.get(key, 0.0) + float(s.get(field, 0.0))
+        return out
+
+    @staticmethod
+    def _hist_quantile(samples, q: float) -> Optional[float]:
+        """Approximate quantile from merged cumulative-bucket samples
+        (the standard histogram_quantile estimate: the upper bound of
+        the bucket where the target rank lands)."""
+        if not samples:
+            return None
+        bounds = samples[0].get("boundaries", [])
+        acc = [0.0] * (len(bounds) + 1)
+        total = 0
+        for s in samples:
+            for i, c in enumerate(s.get("buckets", [])):
+                acc[i] += c
+            total += s.get("count", 0)
+        if total <= 0:
+            return None
+        target = q * total
+        running = 0.0
+        for i, c in enumerate(acc[:-1]):
+            running += c
+            if running >= target:
+                return bounds[i]
+        return bounds[-1] if bounds else None
+
+    async def _serve_state(self) -> Dict[str, Any]:
+        m = await self._workload_snapshot("serve_")
+        deployments: Dict[str, Dict[str, Any]] = {}
+
+        def slot(name: str) -> Dict[str, Any]:
+            return deployments.setdefault(name, {
+                "processed": 0.0, "errors": 0.0, "ongoing": 0.0,
+                "queued": 0.0, "latency_p50_s": None,
+                "latency_p95_s": None})
+
+        for s in m.get("serve_deployment_processed_queries", []):
+            d = slot(s["tags"].get("deployment", "?"))
+            d["processed"] += s["value"]
+            if s["tags"].get("status") == "error":
+                d["errors"] += s["value"]
+        for s in m.get("serve_replica_ongoing_requests", []):
+            slot(s["tags"].get("deployment", "?"))["ongoing"] += s["value"]
+        for s in m.get("serve_deployment_queued_queries", []):
+            slot(s["tags"].get("deployment", "?"))["queued"] += s["value"]
+        by_dep: Dict[str, list] = {}
+        for s in m.get("serve_deployment_processing_latency_seconds", []):
+            by_dep.setdefault(s["tags"].get("deployment", "?"),
+                              []).append(s)
+        for name, samples in by_dep.items():
+            d = slot(name)
+            d["latency_p50_s"] = self._hist_quantile(samples, 0.5)
+            d["latency_p95_s"] = self._hist_quantile(samples, 0.95)
+        ingress = {
+            "requests": self._sum_by(
+                m.get("serve_num_requests", []), "ingress"),
+            "latency_p95_s": self._hist_quantile(
+                m.get("serve_request_latency_seconds", []), 0.95),
+        }
+        return {"deployments": deployments, "ingress": ingress}
+
+    async def _train_state(self) -> Dict[str, Any]:
+        m = await self._workload_snapshot("train_")
+        trials: Dict[str, Dict[str, Any]] = {}
+
+        def slot(name: str) -> Dict[str, Any]:
+            return trials.setdefault(name, {
+                "steps": 0, "step_time_p50_s": None,
+                "step_time_p95_s": None, "breakdown_s": {},
+                "workers": 0.0})
+
+        by_trial: Dict[str, list] = {}
+        for s in m.get("train_step_time_seconds", []):
+            by_trial.setdefault(s["tags"].get("trial", "?"), []).append(s)
+        for name, samples in by_trial.items():
+            t = slot(name)
+            t["steps"] = int(sum(s.get("count", 0) for s in samples))
+            t["step_time_p50_s"] = self._hist_quantile(samples, 0.5)
+            t["step_time_p95_s"] = self._hist_quantile(samples, 0.95)
+        for kind in ("data_wait", "collective", "compute", "step_time"):
+            for s in m.get(f"train_{kind}_seconds", []):
+                t = slot(s["tags"].get("trial", "?"))
+                t["breakdown_s"][kind] = t["breakdown_s"].get(
+                    kind, 0.0) + float(s.get("sum", 0.0))
+        for s in m.get("train_gang_workers", []):
+            slot(s["tags"].get("trial", "?"))["workers"] = s["value"]
+        return {"trials": trials}
 
     async def _metrics(self) -> str:
         from ray_tpu.util.metrics import merge_snapshots, render_prometheus

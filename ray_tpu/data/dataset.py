@@ -11,6 +11,7 @@ every training worker owns a disjoint file/block subset — the reference's
 from __future__ import annotations
 
 import builtins
+import time
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
@@ -36,6 +37,50 @@ class Dataset:
     _actor_stage: Optional[Any] = None        # compute="actors" stage
     _post_transforms: List[Callable] = []     # applied after the stage
     _zip_with: Optional["Dataset"] = None     # row-aligned zip partner
+    _zip_left: Optional["Dataset"] = None     # left side of the zip
+    _zip_post: List[Callable] = []            # transforms on zipped rows
+    _pre_ops: List[tuple] = []                # already-executed op stats
+    _last_stats: Optional[Any] = None         # DatasetStats of last run
+
+    def _clone(self) -> "Dataset":
+        ds = Dataset(self._read_tasks, self._transforms, self._block_refs)
+        ds._actor_stage = self._actor_stage
+        ds._post_transforms = list(self._post_transforms)
+        ds._zip_with = self._zip_with
+        ds._zip_left = self._zip_left
+        ds._zip_post = list(self._zip_post)
+        ds._pre_ops = list(self._pre_ops)
+        return ds
+
+    @property
+    def _plan_outside_read_tasks(self) -> bool:
+        """True when part of this dataset's plan does NOT live in
+        (_read_tasks, _transforms): a zip partner or an actor-pool
+        stage. Ops that re-read those fields directly must flatten
+        first or they silently drop that part of the plan."""
+        return self._zip_with is not None or self._actor_stage is not None
+
+    def _flatten_zip(self) -> "Dataset":
+        """For ops whose distributed paths re-read `_read_tasks` directly
+        (shuffle/sort/groupby/join/union/split/window): a zipped dataset
+        or one with an actor-pool stage must first materialize its
+        output blocks, or the partner/stage would silently vanish
+        (ADVICE r5: zip losing its partner; same class for stages). The
+        blocks stream through the driver; on a cluster they go straight
+        into the object store so the driver holds one block + refs, not
+        every row."""
+        if not self._plan_outside_read_tasks:
+            return self
+        import ray_tpu
+
+        if ray_tpu.is_initialized():
+            from ray_tpu.data.shuffle import block_ref_reader
+
+            refs = [ray_tpu.put(b) for b in self.iter_blocks()]
+            return Dataset([block_ref_reader(r) for r in refs],
+                           block_refs=refs)
+        blocks = list(self.iter_blocks())
+        return Dataset([(lambda b=b: b) for b in blocks])
 
     def _check_not_limited(self, op: str) -> None:
         if self._limit is not None:
@@ -55,6 +100,17 @@ class Dataset:
         fn_constructor_args/kwargs, num_cpus, num_tpus,
         max_tasks_in_flight_per_actor)."""
         self._check_not_limited("map_batches")
+        if self._zip_with is not None:
+            if compute == "actors":
+                raise NotImplementedError(
+                    "compute=\"actors\" after zip() is not supported — "
+                    "materialize() the zipped dataset first")
+            # Post-zip transforms apply to the MERGED stream: dropping
+            # them onto the left chain would silently lose the partner's
+            # columns (ADVICE r5 medium).
+            ds = self._clone()
+            ds._zip_post = self._zip_post + [fn]
+            return ds
         if compute == "actors":
             if self._actor_stage is not None:
                 # Silently dropping the first stage would produce wrong
@@ -68,6 +124,7 @@ class Dataset:
             ds = Dataset(self._read_tasks, self._transforms,
                          self._block_refs)
             ds._actor_stage = ActorPoolStage(fn, **opts)
+            ds._pre_ops = list(self._pre_ops)
             return ds
         if self._actor_stage is not None:
             # Post-stage transforms apply to the stage's streamed output.
@@ -75,14 +132,21 @@ class Dataset:
                          self._block_refs)
             ds._actor_stage = self._actor_stage
             ds._post_transforms = self._post_transforms + [fn]
+            ds._pre_ops = list(self._pre_ops)
             return ds
-        return Dataset(self._read_tasks, self._transforms + [fn])
+        ds = Dataset(self._read_tasks, self._transforms + [fn],
+                     self._block_refs)
+        # An eagerly-executed exchange op (shuffle/sort/join) stays in
+        # the derived dataset's stats report.
+        ds._pre_ops = list(self._pre_ops)
+        return ds
 
     def map(self, fn: Callable[[Dict[str, Any]], Dict[str, Any]]
             ) -> "Dataset":
         def _map_block(block: Block) -> Block:
             return block_from_rows([fn(r) for r in block_to_rows(block)])
 
+        _map_block.__name__ = f"map({getattr(fn, '__name__', 'fn')})"
         return self.map_batches(_map_block)
 
     def filter(self, fn: Callable[[Dict[str, Any]], bool]) -> "Dataset":
@@ -90,6 +154,7 @@ class Dataset:
             rows = [r for r in block_to_rows(block) if fn(r)]
             return block_from_rows(rows)
 
+        _filter_block.__name__ = f"filter({getattr(fn, '__name__', 'fn')})"
         return self.map_batches(_filter_block)
 
     def flat_map(self, fn: Callable[[Dict[str, Any]],
@@ -101,6 +166,7 @@ class Dataset:
                 rows.extend(fn(r))
             return block_from_rows(rows)
 
+        _flat_block.__name__ = f"flat_map({getattr(fn, '__name__', 'fn')})"
         return self.map_batches(_flat_block)
 
     def union(self, *others: "Dataset") -> "Dataset":
@@ -109,6 +175,10 @@ class Dataset:
         self._check_not_limited("union")
         for other in others:
             other._check_not_limited("union")
+        if self._plan_outside_read_tasks or any(
+                o._plan_outside_read_tasks for o in others):
+            return self._flatten_zip().union(
+                *(o._flatten_zip() for o in others))
 
         def bake(ds: "Dataset") -> List[Callable[[], Block]]:
             def wrap(task, transforms):
@@ -132,7 +202,7 @@ class Dataset:
         """First n rows — a terminal streaming cut honored by every
         consumer (iter_blocks stops pulling once satisfied; reference:
         LimitOperator). Transforms must be applied before limit."""
-        ds = Dataset(self._read_tasks, self._transforms)
+        ds = self._clone()  # keeps actor stage / zip partner / refs
         ds._limit = n if self._limit is None else min(n, self._limit)
         return ds
 
@@ -159,17 +229,23 @@ class Dataset:
         cluster is up (reference: _internal/push_based_shuffle.py — the
         driver holds only refs, never rows); in-process otherwise."""
         self._check_not_limited("random_shuffle")
+        if self._plan_outside_read_tasks:
+            return self._flatten_zip().random_shuffle(seed=seed)
         import ray_tpu
 
         if ray_tpu.is_initialized():
             from ray_tpu.data.shuffle import (block_ref_reader,
                                               distributed_random_shuffle)
 
+            t0 = time.perf_counter()
             refs = distributed_random_shuffle(
                 self._read_tasks, self._transforms, seed,
                 max(1, len(self._read_tasks)))
-            return Dataset([block_ref_reader(r) for r in refs],
-                           block_refs=refs)
+            ds = Dataset([block_ref_reader(r) for r in refs],
+                         block_refs=refs)
+            ds._pre_ops = [("random_shuffle",
+                            time.perf_counter() - t0, len(refs))]
+            return ds
         block = self.materialize()
         total = block_num_rows(block)
         rng = np.random.default_rng(seed)
@@ -189,17 +265,22 @@ class Dataset:
         sort when a cluster is up (parts concatenate in key order);
         in-process otherwise."""
         self._check_not_limited("sort")
+        if self._plan_outside_read_tasks:
+            return self._flatten_zip().sort(key, descending)
         import ray_tpu
 
         if ray_tpu.is_initialized():
             from ray_tpu.data.shuffle import (block_ref_reader,
                                               distributed_sort)
 
+            t0 = time.perf_counter()
             refs = distributed_sort(
                 self._read_tasks, self._transforms, key, descending,
                 max(1, len(self._read_tasks)))
-            return Dataset([block_ref_reader(r) for r in refs],
-                           block_refs=refs)
+            ds = Dataset([block_ref_reader(r) for r in refs],
+                         block_refs=refs)
+            ds._pre_ops = [("sort", time.perf_counter() - t0, len(refs))]
+            return ds
         block = self.materialize()
         order = np.argsort(np.asarray(block[key]), kind="stable")
         if descending:
@@ -208,17 +289,23 @@ class Dataset:
         return Dataset([lambda: out])
 
     def groupby(self, key: str) -> "GroupedData":
+        # Stays lazy: the flatten a zipped/staged dataset needs for the
+        # distributed agg path happens at aggregation time (_agg), not
+        # at plan-build time.
         return GroupedData(self, key)
 
     def window(self, *, blocks_per_window: int = 8):
         """Convert to a DatasetPipeline of `blocks_per_window`-block
         windows executing one window at a time (reference:
         Dataset.window) — bounds working-set memory for datasets larger
-        than the object store."""
+        than the object store. A zipped or actor-stage dataset
+        materializes its output blocks here (windowing needs the block
+        list up front)."""
         self._check_not_limited("window")
         from ray_tpu.data.dataset_pipeline import DatasetPipeline
 
-        return DatasetPipeline.from_dataset(self, blocks_per_window)
+        return DatasetPipeline.from_dataset(self._flatten_zip(),
+                                            blocks_per_window)
 
     def repeat(self, times: Optional[int] = None):
         """Multi-epoch pipeline over this dataset (reference:
@@ -226,8 +313,9 @@ class Dataset:
         self._check_not_limited("repeat")
         from ray_tpu.data.dataset_pipeline import DatasetPipeline
 
+        ds = self._flatten_zip()
         return DatasetPipeline.from_dataset(
-            self, blocks_per_window=max(1, len(self._read_tasks))
+            ds, blocks_per_window=max(1, len(ds._read_tasks))
         ).repeat(times)
 
     def zip(self, other: "Dataset") -> "Dataset":
@@ -235,18 +323,51 @@ class Dataset:
         Dataset.zip): row i of the result has the columns of both inputs'
         row i (name clashes get an `_1` suffix). Streaming: both sides
         iterate with row-aligned rebatching; neither fully materializes.
-        Raises at iteration if the row counts differ."""
+        Raises at iteration if the row counts differ. Transforms applied
+        AFTER zip (map/map_batches/filter) run on the merged stream, and
+        zips chain: a.zip(b).zip(c) merges all three."""
         self._check_not_limited("zip")
         other._check_not_limited("zip")
         ds = Dataset(self._read_tasks, self._transforms, self._block_refs)
-        ds._actor_stage = self._actor_stage
-        ds._post_transforms = self._post_transforms
+        # The left stream is THIS dataset in full (including any zip or
+        # post-zip transforms it already carries): iteration recurses
+        # through `_zip_left.iter_blocks()`, so chained zips compose.
+        ds._zip_left = self
         ds._zip_with = other
         return ds
 
-    def _iter_zipped(self, max_in_flight: int) -> Iterator[Block]:
-        left = self._unzipped_blocks(max_in_flight)
-        right = self._zip_with.iter_blocks(max_in_flight)
+    def _iter_zipped(self, max_in_flight: int,
+                     stats: Optional[Any] = None,
+                     record: bool = True) -> Iterator[Block]:
+        gen = self._iter_zipped_inner(max_in_flight, stats, record)
+        try:
+            yield from gen
+        finally:
+            # Fold each side's per-operator report into the zipped
+            # dataset's stats — without this, z.stats() would show only
+            # the 'zip' op and lose every upstream read/map operator.
+            # Left ops sit at 100+, right at 300+, zip/post at 1000+.
+            if stats is not None:
+                for src, base in ((self._zip_left, 100),
+                                  (self._zip_with, 300)):
+                    sub = getattr(src, "_last_stats", None)
+                    if sub is None:
+                        continue
+                    for i, o in enumerate(sub.operators):
+                        stats.fold_op(base + i, o)
+                    stats.wait_s += sub.wait_s
+
+    def _iter_zipped_inner(self, max_in_flight: int,
+                           stats: Optional[Any] = None,
+                           record: bool = True) -> Iterator[Block]:
+        import time as _time
+
+        # record=False (a schema() probe) propagates to both sides so
+        # the probe can't clobber the partners' real-run stats either.
+        left = self._zip_left.iter_blocks(max_in_flight,
+                                          _record_stats=record)
+        right = self._zip_with.iter_blocks(max_in_flight,
+                                           _record_stats=record)
         lbuf: Optional[Block] = None
         rbuf: Optional[Block] = None
         while True:
@@ -256,12 +377,27 @@ class Dataset:
                 rbuf = next(right, None)
             if lbuf is None or rbuf is None:
                 break
+            t0 = _time.perf_counter()
             n = min(block_num_rows(lbuf), block_num_rows(rbuf))
             lcut = block_slice(lbuf, 0, n)
             rcut = block_slice(rbuf, 0, n)
             out = dict(lcut)
             for c, v in rcut.items():
                 out[c if c not in out else f"{c}_1"] = v
+            if stats is not None:
+                from ray_tpu.data.stats import block_rows_bytes
+
+                rows, nbytes = block_rows_bytes(out)
+                stats.record_op(1_000, "zip", _time.perf_counter() - t0,
+                                rows, nbytes)
+            for i, t in enumerate(self._zip_post):
+                t0 = _time.perf_counter()
+                out = t(out)
+                if stats is not None:
+                    rows, nbytes = block_rows_bytes(out)
+                    stats.record_op(
+                        1_001 + i, getattr(t, "__name__", f"post_{i}"),
+                        _time.perf_counter() - t0, rows, nbytes)
             yield out
             lbuf = block_slice(lbuf, n, block_num_rows(lbuf))
             rbuf = block_slice(rbuf, n, block_num_rows(rbuf))
@@ -284,6 +420,10 @@ class Dataset:
         other._check_not_limited("join")
         if how not in ("inner", "left", "right", "outer"):
             raise ValueError(f"unsupported join how={how!r}")
+        if self._plan_outside_read_tasks or other._plan_outside_read_tasks:
+            return self._flatten_zip().join(
+                other._flatten_zip(), on, how,
+                num_partitions=num_partitions)
         import ray_tpu
 
         if ray_tpu.is_initialized():
@@ -291,11 +431,15 @@ class Dataset:
                                               distributed_join)
 
             parts = num_partitions or max(1, len(self._read_tasks))
+            t0 = time.perf_counter()
             refs = distributed_join(
                 self._read_tasks, self._transforms,
                 other._read_tasks, other._transforms, on, how, parts)
-            return Dataset([block_ref_reader(r) for r in refs],
-                           block_refs=refs)
+            ds = Dataset([block_ref_reader(r) for r in refs],
+                         block_refs=refs)
+            ds._pre_ops = [(f"join({how})",
+                            time.perf_counter() - t0, len(refs))]
+            return ds
         import pandas as pd
 
         ldf = pd.DataFrame(self.materialize())
@@ -305,23 +449,52 @@ class Dataset:
         return Dataset([lambda: block])
 
     # -- execution ------------------------------------------------------
-    def _executor(self, max_in_flight: int = 4) -> StreamingExecutor:
+    def _executor(self, max_in_flight: int = 4,
+                  stats: Optional[Any] = None) -> StreamingExecutor:
         return StreamingExecutor(self._read_tasks, self._transforms,
-                                 max_in_flight=max_in_flight)
+                                 max_in_flight=max_in_flight, stats=stats)
 
-    def iter_blocks(self, max_in_flight: int = 4) -> Iterator[Block]:
+    def _new_stats(self, record: bool = True):
+        """Fresh DatasetStats for one execution, seeded with any
+        already-executed exchange ops (shuffle/sort/join run eagerly)."""
+        from ray_tpu.data.stats import DatasetStats, OpStats
+
+        stats = DatasetStats()
+        for i, (name, wall_s, blocks) in enumerate(self._pre_ops):
+            op = OpStats(name)
+            op.wall_s = wall_s
+            op.blocks = blocks
+            op.min_block_s = op.max_block_s = wall_s
+            stats.fold_op(-len(self._pre_ops) + i, op)
+        if record:
+            self._last_stats = stats
+        return stats
+
+    def iter_blocks(self, max_in_flight: int = 4, *,
+                    _record_stats: bool = True) -> Iterator[Block]:
+        stats = self._new_stats(record=_record_stats)
         if self._zip_with is not None:
-            blocks = self._iter_zipped(max_in_flight)
-            if self._limit is None:
-                return blocks
-            return self._limited(blocks, self._limit)
-        blocks = self._unzipped_blocks(max_in_flight)
-        if self._limit is None:
-            return blocks
-        return self._limited(blocks, self._limit)
+            blocks = self._iter_zipped(max_in_flight, stats,
+                                       record=_record_stats)
+        else:
+            blocks = self._unzipped_blocks(max_in_flight, stats)
+        if self._limit is not None:
+            blocks = self._limited(blocks, self._limit)
+        return self._finalizing(blocks, stats)
 
-    def _unzipped_blocks(self, max_in_flight: int = 4) -> Iterator[Block]:
+    @staticmethod
+    def _finalizing(blocks: Iterator[Block], stats) -> Iterator[Block]:
+        """Stamp end-to-end wall time when iteration ends — fully drained
+        OR dropped early by the consumer (generator close)."""
+        try:
+            yield from blocks
+        finally:
+            stats.finalize()
+
+    def _unzipped_blocks(self, max_in_flight: int = 4,
+                         stats: Optional[Any] = None) -> Iterator[Block]:
         import ray_tpu
+        from ray_tpu.data.stats import timed_block_iter
 
         if self._actor_stage is not None:
             if ray_tpu.is_initialized():
@@ -332,26 +505,48 @@ class Dataset:
                 # "replica"), keeping semantics identical for unit tests.
                 from ray_tpu.data.actor_compute import _MapWorker
 
+                # stats=None: the coarse timed_block_iter below already
+                # covers this stream — recording the chain ops here too
+                # would double-count compute in the report.
                 worker = _MapWorker(self._actor_stage.fn,
                                     self._actor_stage.ctor_args,
                                     self._actor_stage.ctor_kwargs)
-                ex = self._executor(max_in_flight)
+                ex = self._executor(max_in_flight, None)
                 blocks = (worker.apply(b) for b in ex.run_local())
+            # Coarse per-block timing: the stage streams through a pool
+            # of remote actors, so per-operator remote times aren't
+            # available — one "actor_pool_map" entry covers the stage.
+            blocks = timed_block_iter(blocks, stats, 500,
+                                      "actor_pool_map")
             if self._post_transforms:
                 post = list(self._post_transforms)
 
                 def _applied(src):
+                    import time as _time
+
                     for b in src:
-                        for t in post:
+                        for i, t in enumerate(post):
+                            t0 = _time.perf_counter()
                             b = t(b)
+                            if stats is not None:
+                                from ray_tpu.data.stats import (
+                                    block_rows_bytes)
+
+                                rows, nbytes = block_rows_bytes(b)
+                                stats.record_op(
+                                    501 + i,
+                                    getattr(t, "__name__", f"post_{i}"),
+                                    _time.perf_counter() - t0,
+                                    rows, nbytes)
                         yield b
 
                 blocks = _applied(blocks)
         elif (self._block_refs is not None and not self._transforms
                 and ray_tpu.is_initialized()):
-            blocks = self._iter_block_refs()
+            blocks = timed_block_iter(self._iter_block_refs(), stats, 0,
+                                      "materialized_read")
         else:
-            ex = self._executor(max_in_flight)
+            ex = self._executor(max_in_flight, stats)
             blocks = (iter(ex) if ray_tpu.is_initialized()
                       else ex.run_local())
         return blocks
@@ -529,8 +724,23 @@ class Dataset:
     def materialize(self) -> Block:
         return concat_blocks(list(self.iter_blocks()))
 
+    def stats(self):
+        """Execution statistics of this dataset's most recent run
+        (reference: `Dataset.stats()`): per-operator wall time, rows,
+        bytes, throughput, block counts, and the consumer-wait vs
+        operator-compute split. If the dataset has never executed, one
+        full pass runs first so the report is populated. The returned
+        DatasetStats prints as the familiar per-operator report."""
+        if self._last_stats is None:
+            for _ in self.iter_blocks():
+                pass
+        return self._last_stats
+
     def schema(self) -> Optional[Dict[str, str]]:
-        for block in self.iter_blocks(max_in_flight=1):
+        # _record_stats=False: this one-block probe must not overwrite
+        # the stats of a real execution the user just ran.
+        for block in self.iter_blocks(max_in_flight=1,
+                                      _record_stats=False):
             if block:
                 return {c: str(v.dtype) for c, v in block.items()}
         return None
@@ -542,16 +752,24 @@ class Dataset:
     # -- sharding (reference: DataConfig per-worker shards) --------------
     def split(self, n: int) -> List["Dataset"]:
         self._check_not_limited("split")
+        if self._plan_outside_read_tasks:
+            ds = self._flatten_zip()
+            return [Dataset(ds._read_tasks[i::n], ds._transforms)
+                    for i in builtins.range(n)]
         # builtins.range: the module-level `range` is the Dataset factory.
         return [Dataset(self._read_tasks[i::n], self._transforms)
                 for i in builtins.range(n)]
 
     def split_for_workers(self, n: int) -> List["Dataset"]:
-        if len(self._read_tasks) < n:
+        # Flatten first so the block-count precondition is checked
+        # against the ACTUAL output blocks, not the left side of a zip
+        # or the input of an actor stage.
+        ds = self._flatten_zip()
+        if len(ds._read_tasks) < n:
             raise ValueError(
-                f"cannot shard {len(self._read_tasks)} block(s) across "
+                f"cannot shard {len(ds._read_tasks)} block(s) across "
                 f"{n} workers; increase parallelism/file count")
-        return self.split(n)
+        return ds.split(n)
 
     def __repr__(self) -> str:
         return (f"Dataset(num_blocks={self.num_blocks}, "
@@ -576,9 +794,14 @@ class GroupedData:
             from ray_tpu.data.shuffle import (block_ref_reader,
                                               distributed_group_agg)
 
+            # The exchange re-reads (_read_tasks, _transforms): a zipped
+            # or actor-stage dataset must flatten first or that part of
+            # the plan silently vanishes. (The local path below iterates
+            # rows, which already includes it.)
+            src = self._ds._flatten_zip()
             refs = distributed_group_agg(
-                self._ds._read_tasks, self._ds._transforms, self._key,
-                kind, on, fn, max(1, len(self._ds._read_tasks)))
+                src._read_tasks, src._transforms, self._key,
+                kind, on, fn, max(1, len(src._read_tasks)))
             out = Dataset([block_ref_reader(r) for r in refs],
                           block_refs=refs)
             if kind == "map_groups":

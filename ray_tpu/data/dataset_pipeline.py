@@ -21,6 +21,11 @@ class DatasetPipeline:
                  epochs: int = 1):
         self._windows = list(window_factories)
         self._epochs = epochs
+        # Incrementally merged stats of consumed windows. Folding as each
+        # window finishes (instead of retaining the window Datasets)
+        # keeps an infinite `repeat()` pipeline O(1) in memory.
+        self._stats_acc: Any = None
+        self._exec_wall_s = 0.0  # sum of consumed windows' wall time
 
     # -- construction ---------------------------------------------------
     @classmethod
@@ -74,10 +79,30 @@ class DatasetPipeline:
         epoch = 0
         while self._epochs < 0 or epoch < self._epochs:
             for factory in self._windows:
-                yield factory()
+                ds = factory()
+                try:
+                    yield ds
+                finally:
+                    # Runs when the consumer advances past (or abandons)
+                    # the window — its stats are final by then.
+                    self._fold_window_stats(ds)
             epoch += 1
             if not self._windows:
                 break
+
+    def _fold_window_stats(self, ds: Any) -> None:
+        stats = getattr(ds, "_last_stats", None)
+        if stats is None:
+            return
+        if self._stats_acc is None:
+            from ray_tpu.data.stats import DatasetStats
+
+            self._stats_acc = DatasetStats()
+        for i, o in enumerate(stats.operators):
+            self._stats_acc.fold_op(i, o)
+        self._stats_acc.wait_s += stats.wait_s
+        stats.finalize()  # idempotent; partial windows stamp here
+        self._exec_wall_s += stats.total_wall_s or 0.0
 
     def iter_epochs(self) -> Iterator["DatasetPipeline"]:
         """One single-epoch pipeline per epoch (reference:
@@ -120,6 +145,22 @@ class DatasetPipeline:
         if self._epochs < 0:
             raise ValueError("count() on an infinite pipeline")
         return sum(block_num_rows(b) for b in self.iter_blocks())
+
+    def stats(self):
+        """Merged per-operator stats across every window consumed so far
+        (reference: DatasetPipeline.stats()). Operator entries fold by
+        (position, name), so N windows of the same plan show one entry
+        per operator with N× the blocks."""
+        from ray_tpu.data.stats import DatasetStats
+
+        merged = self._stats_acc
+        if merged is None:
+            merged = DatasetStats()
+        # Execution time is the sum of the windows' wall time — NOT the
+        # clock since the accumulator was created (idle time between a
+        # run and the stats() call must not count).
+        merged.total_wall_s = self._exec_wall_s
+        return merged
 
     @property
     def num_windows(self) -> int:

@@ -10,21 +10,45 @@ Design deviation (deliberate): a chain of map stages is fused into ONE
 remote task per block (read -> transform*), the same fusion the reference's
 optimizer performs for compatible map operators; there is no per-stage
 actor pool yet.
+
+Observability: each block task returns its per-operator wall times next
+to the block (reference: `_internal/stats.py` — stats ride the block
+metadata back to the driver), so `Dataset.stats()` reports the REAL
+remote compute time per operator plus the driver's wait time.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Iterator, List
+import time
+from typing import Callable, Iterator, List, Optional
 
 from ray_tpu.data.block import Block
+from ray_tpu.data.stats import DatasetStats, block_rows_bytes
 
 
-def _run_chain(read_task: Callable[[], Block],
-               transforms: List[Callable[[Block], Block]]) -> Block:
+def _op_name(fn: Callable, index: int) -> str:
+    name = getattr(fn, "__name__", "")
+    if not name or name == "<lambda>":
+        name = f"transform_{index}"
+    return name
+
+
+def _run_chain_timed(read_task: Callable[[], Block],
+                     transforms: List[Callable[[Block], Block]]) -> dict:
+    """Fused read->transform* chain + per-operator timing, shipped back
+    with the block."""
+    t0 = time.perf_counter()
     block = read_task()
-    for t in transforms:
+    dt = time.perf_counter() - t0
+    rows, nbytes = block_rows_bytes(block)
+    ops = [("read", dt, rows, nbytes)]
+    for i, t in enumerate(transforms):
+        t0 = time.perf_counter()
         block = t(block)
-    return block
+        dt = time.perf_counter() - t0
+        rows, nbytes = block_rows_bytes(block)
+        ops.append((_op_name(t, i), dt, rows, nbytes))
+    return {"block": block, "ops": ops}
 
 
 class StreamingExecutor:
@@ -33,16 +57,24 @@ class StreamingExecutor:
 
     def __init__(self, read_tasks: List[Callable[[], Block]],
                  transforms: List[Callable[[Block], Block]],
-                 max_in_flight: int = 4, locality: str = "driver"):
+                 max_in_flight: int = 4, locality: str = "driver",
+                 stats: Optional[DatasetStats] = None):
         self.read_tasks = read_tasks
         self.transforms = transforms
         self.max_in_flight = max(1, max_in_flight)
         self.locality = locality
+        self.stats = stats
+
+    def _record(self, payload: dict) -> Block:
+        if self.stats is not None:
+            for i, (name, dt, rows, nbytes) in enumerate(payload["ops"]):
+                self.stats.record_op(i, name, dt, rows, nbytes)
+        return payload["block"]
 
     def __iter__(self) -> Iterator[Block]:
         import ray_tpu
 
-        run = ray_tpu.remote(num_cpus=1)(_run_chain)
+        run = ray_tpu.remote(num_cpus=1)(_run_chain_timed)
         pending = list(self.read_tasks)
         # Submission order is preserved in the output (deterministic
         # ordering, like the reference's preserve_order execution option).
@@ -51,10 +83,14 @@ class StreamingExecutor:
             while pending and len(window) < self.max_in_flight:
                 window.append(run.remote(pending.pop(0), self.transforms))
             ref, window = window[0], window[1:]
-            yield ray_tpu.get(ref, timeout=600)
+            t0 = time.perf_counter()
+            payload = ray_tpu.get(ref, timeout=600)
+            if self.stats is not None:
+                self.stats.record_wait(time.perf_counter() - t0)
+            yield self._record(payload)
 
     def run_local(self) -> Iterator[Block]:
         """In-process execution (no cluster): used when the runtime is not
         initialized, keeping Dataset usable as a plain library."""
         for rt in self.read_tasks:
-            yield _run_chain(rt, self.transforms)
+            yield self._record(_run_chain_timed(rt, self.transforms))

@@ -157,6 +157,9 @@ def shuffle_map(source, transforms, partitioner, num_parts: int,
     """Run the block chain (or take a materialized block), split into
     `num_parts` sub-blocks by the partitioner. Returned as a tuple so
     num_returns=R turns each part into its own object."""
+    import time as _time
+
+    t0 = _time.perf_counter()
     if callable(source):
         block = source()
         for t in transforms:
@@ -168,13 +171,19 @@ def shuffle_map(source, transforms, partitioner, num_parts: int,
     for j in range(num_parts):
         idx = np.nonzero(ids == j)[0]
         parts.append({c: np.asarray(v)[idx] for c, v in block.items()})
+    _record_exchange("map", _time.perf_counter() - t0)
     return tuple(parts) if num_parts > 1 else parts[0]
 
 
 def shuffle_reduce(finalize, part_index: int, *parts):
+    import time as _time
+
+    t0 = _time.perf_counter()
     live = [p for p in parts if p and block_num_rows(p)]
     block = concat_blocks(live) if live else {}
-    return finalize(block, part_index)
+    out = finalize(block, part_index)
+    _record_exchange("reduce", _time.perf_counter() - t0)
+    return out
 
 
 def join_reduce(on: str, how: str, n_left: int, part_index: int, *parts):
@@ -210,6 +219,33 @@ def sample_keys(block: Block, key: str, k: int = 64):
         return vals
     idx = np.linspace(0, len(vals) - 1, k).astype(int)
     return vals[idx]
+
+
+def _record_exchange(phase: str, seconds: float) -> None:
+    """Export shuffle task time to this worker's metrics registry
+    (pushed to the raylet -> dashboard /metrics): per-phase counters so
+    operators can see where an all-to-all spends its time without
+    attaching a profiler to every worker."""
+    try:
+        from ray_tpu.util.metrics import Counter, get_instruments
+
+        def build():
+            return {
+                "seconds": Counter(
+                    "data_exchange_seconds",
+                    "Wall seconds spent in Dataset exchange tasks",
+                    tag_keys=("phase",)),
+                "tasks": Counter(
+                    "data_exchange_tasks",
+                    "Dataset exchange tasks executed",
+                    tag_keys=("phase",)),
+            }
+
+        m = get_instruments("data.exchange", build)
+        m["seconds"].inc(seconds, tags={"phase": phase})
+        m["tasks"].inc(1, tags={"phase": phase})
+    except Exception:
+        pass  # metrics must never fail the data path
 
 
 def block_ref_reader(ref):

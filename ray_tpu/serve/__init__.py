@@ -219,11 +219,21 @@ def start(http_options: Optional[HTTPOptions] = None) -> int:
     return _http_port
 
 
-def start_grpc_ingress(port: int = 0, host: str = "127.0.0.1") -> int:
+def start_grpc_ingress(port: int = 0, host: str = "127.0.0.1",
+                       allow_pickle: bool = True) -> int:
     """Start (or find) the gRPC ingress (reference: serve.start's
     grpc_options / gRPCProxy): a detached actor serving
     /ray_tpu.serve.ServeAPIService/Call. Returns the bound port; reach it
-    with `serve.GrpcServeClient(f"127.0.0.1:{port}")`."""
+    with `serve.GrpcServeClient(f"127.0.0.1:{port}")`.
+
+    The ingress unpickles request payloads by default, so it is
+    TRUSTED-NETWORK-ONLY (see grpc_proxy.py's module docstring);
+    `allow_pickle=False` restricts it to msgpack-native payloads for
+    exposure to non-Python clients. Asking for allow_pickle=False while
+    a pickle-enabled ingress is already running raises (the guarantee
+    cannot be retrofitted); the reverse — a default caller finding a
+    msgpack-only ingress — attaches to it, and pickle payloads are then
+    rejected per request."""
     global _grpc_port
     import ray_tpu
     from ray_tpu.serve._private.grpc_proxy import GrpcIngress
@@ -234,8 +244,18 @@ def start_grpc_ingress(port: int = 0, host: str = "127.0.0.1") -> int:
     except Exception:
         actor_cls = ray_tpu.remote(num_cpus=0, name=_GRPC_PROXY_NAME,
                                    max_concurrency=64)(GrpcIngress)
-        proxy = actor_cls.remote(host, port)
+        proxy = actor_cls.remote(host, port, allow_pickle)
         _grpc_port = ray_tpu.get(proxy.start.remote(), timeout=60)
+        return _grpc_port
+    # Existing ingress: the no-pickle guarantee cannot be retrofitted —
+    # silently returning a pickle-enabled port would void what the
+    # caller explicitly asked for.
+    if not allow_pickle and ray_tpu.get(
+            proxy.allows_pickle.remote(), timeout=30):
+        raise RayServeException(
+            "gRPC ingress is already running WITH pickle payloads "
+            "enabled; serve.shutdown() it before starting an "
+            "allow_pickle=False ingress")
     if _grpc_port is None:
         _grpc_port = ray_tpu.get(proxy.start.remote(), timeout=60)
     return _grpc_port

@@ -12,9 +12,26 @@ gRPC metadata headers (`application`, `method_name`,
 `multiplexed_model_id`) so non-Python clients can route without
 understanding the body encoding.
 
+SECURITY / TRUST BOUNDARY (ADVICE r5): the default `payload` field is
+**unpickled server-side**, and unpickling attacker-controlled bytes is
+arbitrary code execution. This port therefore carries exactly the same
+trust model as every other ray_tpu port (raylet RPC, GCS, object
+transfer — and Ray's own ports in the reference): it MUST only be
+reachable from a trusted network. It binds 127.0.0.1 by default; if you
+expose it wider, put authn/z in front of it. Non-Python clients (which
+cannot produce pickle anyway) should use the `msgpack_payload` field —
+msgpack-native `[args, kwargs]` — and operators who want to guarantee
+no pickle ever crosses this boundary can start the ingress with
+`allow_pickle=False` (`serve.start_grpc_ingress(allow_pickle=False)`),
+which rejects pickled payloads instead of loading them and answers in
+msgpack-native form only.
+
 Request body : msgpack {app?, deployment?, method?, model_id?,
-               payload: pickled (args, kwargs)}
-Response body: msgpack {ok: bool, payload?: pickled result, error?: str}
+               payload: pickled (args, kwargs)            # trusted nets
+               | msgpack_payload: [args, kwargs]}         # codec-safe
+Response body: msgpack {ok: bool, payload?: pickled result
+                        | msgpack_payload?: result, error?: str}
+(the response mirrors the request's payload encoding)
 """
 
 from __future__ import annotations
@@ -35,17 +52,28 @@ SERVICE = "ray_tpu.serve.ServeAPIService"
 class GrpcIngress:
     """Serves deployment calls over gRPC (grpc.aio, generic handler)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 allow_pickle: bool = True):
         self._host, self._port = host, port
+        # allow_pickle=False: msgpack-native payloads only — the ingress
+        # never unpickles client bytes (see module docstring).
+        self._allow_pickle = allow_pickle
         self._server = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._handles: Dict[str, Any] = {}
         self._started = threading.Event()
+        self._known_lock = threading.Lock()
 
     @property
     def port(self) -> int:
         return self._port
+
+    def allows_pickle(self) -> bool:
+        """Control-plane probe: lets start_grpc_ingress refuse to hand a
+        pickle-enabled ingress to a caller that asked for the msgpack-only
+        guarantee."""
+        return self._allow_pickle
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> int:
@@ -93,6 +121,46 @@ class GrpcIngress:
                 pass
 
     # -- data plane -----------------------------------------------------
+    _known: frozenset = frozenset()
+    _known_at: float = 0.0
+
+    def _known_deployment(self, name: str) -> bool:
+        """Validate a CLIENT-SUPPLIED deployment name against the
+        controller's table before it becomes a metric tag or a cached
+        handle — arbitrary names per request must not mint unbounded
+        metric series / handle-cache entries. Misses re-check the
+        controller at most once per second: a just-deployed app is
+        routable within ~1s while a bogus-name flood still costs one
+        controller call per second, not per request. BLOCKING — callers
+        on an event loop wrap it in asyncio.to_thread."""
+        import time
+
+        if name in self._known:
+            return True
+        # Single-flight + stamp-before-call: concurrent misses and
+        # FAILED lookups must also be throttled, or an unknown-name
+        # flood during a controller outage turns into one blocked
+        # 10s controller call per request.
+        with self._known_lock:
+            if name in self._known:
+                return True
+            now = time.monotonic()
+            if now - self._known_at < 1.0:
+                return False
+            self._known_at = now
+            try:
+                import ray_tpu
+                from ray_tpu.serve._private.controller import (
+                    CONTROLLER_NAME)
+
+                controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                status = ray_tpu.get(controller.status.remote(),
+                                     timeout=10)
+                self._known = frozenset(status)
+            except Exception:
+                return False
+            return name in self._known
+
     def _handle_for(self, deployment: str):
         handle = self._handles.get(deployment)
         if handle is None:
@@ -106,6 +174,20 @@ class GrpcIngress:
         return handle
 
     async def _call(self, request: bytes, context) -> bytes:
+        import time
+
+        from ray_tpu.serve._private.metrics import proxy_metrics
+        from ray_tpu.util.tracing import span
+
+        try:
+            metrics = proxy_metrics()
+        except Exception:
+            metrics = None
+        deployment = ""
+        route_tag = "unmatched"
+        status = "ok"
+        msgpack_mode = False
+        t0 = time.perf_counter()
         try:
             meta = {k: v for k, v in (context.invocation_metadata() or ())}
             req = msgpack.unpackb(request, raw=False) \
@@ -116,11 +198,30 @@ class GrpcIngress:
                 raise ValueError(
                     "no target: set 'app' in the request body or the "
                     "'application' metadata key")
+            # to_thread: the cache-refresh path blocks on the controller
+            # (up to 10s); it must not stall the ingress event loop.
+            if not await asyncio.to_thread(self._known_deployment,
+                                           deployment):
+                raise ValueError(
+                    f"unknown application {deployment!r}")
+            route_tag = f"/{deployment}"
             method = (req.get("method") or meta.get("method_name")
                       or "__call__")
             model_id = (req.get("model_id")
                         or meta.get("multiplexed_model_id") or "")
-            if req.get("payload") is not None:
+            if req.get("msgpack_payload") is not None:
+                # Codec-safe path: no pickle touches client bytes, and
+                # the response answers in kind.
+                msgpack_mode = True
+                args, kwargs = req["msgpack_payload"]
+                args = tuple(args)
+                kwargs = dict(kwargs or {})
+            elif req.get("payload") is not None:
+                if not self._allow_pickle:
+                    raise ValueError(
+                        "this ingress runs with allow_pickle=False: "
+                        "send msgpack_payload=[args, kwargs] instead of "
+                        "a pickled payload")
                 args, kwargs = pickle.loads(req["payload"])
             else:
                 args, kwargs = (), {}
@@ -129,27 +230,80 @@ class GrpcIngress:
                 handle = handle.options(multiplexed_model_id=model_id)
             if method != "__call__":
                 handle = handle.options(method_name=method)
-            # handle.remote().result() blocks a worker thread, not the
-            # aio loop.
-            resp = handle.remote(*args, **kwargs)
-            result = await asyncio.to_thread(resp.result, 60.0)
+            # One trace id across proxy -> router -> replica: the router
+            # span nests under this via the ambient contextvar, which
+            # survives both `handle.remote()` (called on this task) and
+            # the worker thread (to_thread copies the context).
+            with span("serve.proxy",
+                      parent=meta.get("traceparent"),
+                      attributes={"ingress": "grpc",
+                                  "deployment": deployment,
+                                  "method": method,
+                                  "component": "proxy"}):
+                # handle.remote().result() blocks a worker thread, not
+                # the aio loop.
+                resp = handle.remote(*args, **kwargs)
+                result = await asyncio.to_thread(resp.result, 60.0)
+            if msgpack_mode or not self._allow_pickle:
+                return msgpack.packb(
+                    {"ok": True, "msgpack_payload": result},
+                    use_bin_type=True, default=_msgpack_default)
             return msgpack.packb(
                 {"ok": True, "payload": pickle.dumps(result)},
                 use_bin_type=True)
         except Exception as e:  # noqa: BLE001
+            status = "error"
             logger.debug("grpc ingress call failed", exc_info=True)
             return msgpack.packb(
                 {"ok": False, "error": f"{type(e).__name__}: {e}"},
                 use_bin_type=True)
+        finally:
+            if metrics is not None:
+                try:
+                    # route_tag is "unmatched" until the deployment name
+                    # validated against the controller table: arbitrary
+                    # client strings must not become metric series.
+                    metrics["requests"].inc(1, tags={
+                        "ingress": "grpc", "route": route_tag,
+                        "status": status})
+                    metrics["latency"].observe(
+                        time.perf_counter() - t0,
+                        tags={"ingress": "grpc", "route": route_tag})
+                except Exception:
+                    pass
+
+
+def _msgpack_default(obj):
+    """Best-effort msgpack coercion for numpy scalars/arrays in
+    msgpack-native responses."""
+    import numpy as np
+
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    raise TypeError(
+        f"result of type {type(obj).__name__} is not msgpack-"
+        "serializable; use the pickle payload mode for arbitrary "
+        "Python results")
 
 
 class GrpcServeClient:
     """Minimal client for the ingress (reference: the generated
-    RayServeAPIServiceStub, hand-rolled over a generic channel)."""
+    RayServeAPIServiceStub, hand-rolled over a generic channel).
 
-    def __init__(self, address: str):
+    `payload_format="msgpack"` sends args/kwargs msgpack-native — what a
+    non-Python client would produce — and is the only format an
+    `allow_pickle=False` ingress accepts."""
+
+    def __init__(self, address: str, payload_format: str = "pickle"):
         import grpc
 
+        if payload_format not in ("pickle", "msgpack"):
+            raise ValueError(
+                f"payload_format must be 'pickle' or 'msgpack', got "
+                f"{payload_format!r}")
+        self._payload_format = payload_format
         self._channel = grpc.insecure_channel(address)
         self._call = self._channel.unary_unary(
             f"/{SERVICE}/Call",
@@ -157,16 +311,21 @@ class GrpcServeClient:
 
     def call(self, app: str, *args, method: str = "__call__",
              model_id: str = "", timeout: float = 60.0, **kwargs) -> Any:
-        req = msgpack.packb({
-            "app": app, "method": method, "model_id": model_id,
-            "payload": pickle.dumps((args, kwargs)),
-        }, use_bin_type=True)
+        body: Dict[str, Any] = {
+            "app": app, "method": method, "model_id": model_id}
+        if self._payload_format == "msgpack":
+            body["msgpack_payload"] = [list(args), kwargs]
+        else:
+            body["payload"] = pickle.dumps((args, kwargs))
+        req = msgpack.packb(body, use_bin_type=True)
         raw = self._call(req, timeout=timeout)
         resp = msgpack.unpackb(raw, raw=False)
         if not resp.get("ok"):
             from ray_tpu.serve.exceptions import RayServeException
 
             raise RayServeException(resp.get("error", "ingress error"))
+        if "msgpack_payload" in resp:
+            return resp["msgpack_payload"]
         return pickle.loads(resp["payload"])
 
     def close(self) -> None:

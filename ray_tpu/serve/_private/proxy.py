@@ -133,6 +133,11 @@ class HTTPProxy:
                 "headers": headers, "body": body}
 
     async def _dispatch(self, request: dict):
+        import time
+
+        from ray_tpu.serve._private.metrics import proxy_metrics
+        from ray_tpu.util.tracing import span
+
         deployment = self._match_route(request["path"])
         if deployment is None:
             # Route miss: the periodic refresh may simply not have seen a
@@ -143,16 +148,59 @@ class HTTPProxy:
             except Exception:
                 pass
             deployment = self._match_route(request["path"])
+        try:
+            metrics = proxy_metrics()
+        except Exception:
+            metrics = None
+
+        # Tag with the BOUNDED matched deployment, never the raw path:
+        # unique URLs (bot scans, per-user suffixes) must not mint a new
+        # metric series each (the tag-cardinality rule every Prometheus
+        # deployment learns the hard way).
+        route_tag = f"/{deployment}" if deployment else "unmatched"
+
+        def _count(status: str) -> None:
+            if metrics is not None:
+                try:
+                    metrics["requests"].inc(1, tags={
+                        "ingress": "http", "route": route_tag,
+                        "status": status})
+                except Exception:
+                    pass
+
         if deployment is None:
+            _count("not_found")
             return b"404 Not Found", b"no route", b"text/plain"
         handle = self._handle_for(deployment)
+        t0 = time.perf_counter()
         try:
-            # Routing + result are blocking; keep the proxy loop free.
-            value = await asyncio.to_thread(
-                self._call_blocking, handle, request)
+            # The ingress span honors an inbound W3C `traceparent` header
+            # (external tracer continuity); the router/replica spans nest
+            # under it via the ambient context — asyncio.to_thread copies
+            # contextvars into the worker thread.
+            with span("serve.proxy",
+                      parent=request["headers"].get("traceparent"),
+                      attributes={"ingress": "http",
+                                  "route": request["path"],
+                                  "deployment": deployment,
+                                  "method": request["method"],
+                                  "component": "proxy"}):
+                # Routing + result are blocking; keep the proxy loop free.
+                value = await asyncio.to_thread(
+                    self._call_blocking, handle, request)
         except Exception as e:  # noqa: BLE001
+            _count("error")
             return (b"500 Internal Server Error",
                     f"{type(e).__name__}: {e}".encode(), b"text/plain")
+        finally:
+            if metrics is not None:
+                try:
+                    metrics["latency"].observe(
+                        time.perf_counter() - t0,
+                        tags={"ingress": "http", "route": route_tag})
+                except Exception:
+                    pass
+        _count("ok")
         if isinstance(value, (dict, list)):
             return (b"200 OK", json.dumps(value).encode(),
                     b"application/json")

@@ -54,6 +54,10 @@ class Replica:
         self._draining = False
         self._started_at = time.time()
 
+    def _metric_tags(self) -> Dict[str, str]:
+        return {"deployment": self.deployment_name,
+                "replica": self.replica_id}
+
     # -- data plane ----------------------------------------------------
     async def handle_request(self, method_name: str, args: Tuple,
                              kwargs: Dict,
@@ -63,6 +67,9 @@ class Replica:
 
             raise ReplicaDrainingError(
                 f"replica {self.replica_id} is draining")
+        from ray_tpu.serve._private.metrics import replica_metrics
+        from ray_tpu.util.tracing import span
+
         self._ongoing += 1
         self._total += 1
         token = None
@@ -72,15 +79,47 @@ class Replica:
             token = _set_request_model_id(
                 metadata["multiplexed_model_id"])
         try:
-            target = self._instance if method_name == "__call__" else None
-            method = (getattr(self._instance, method_name)
-                      if target is None else self._resolve_call())
-            if inspect.iscoroutinefunction(method):
-                return await method(*args, **kwargs)
-            # Sync user code must not block the replica's event loop.
-            return await asyncio.to_thread(method, *args, **kwargs)
+            metrics = replica_metrics()
+            tags = self._metric_tags()
+            metrics["ongoing"].set(self._ongoing, tags=tags)
+        except Exception:
+            metrics = None
+        status = "ok"
+        t0 = time.perf_counter()
+        try:
+            # Explicit parent: async actor methods execute on the actor
+            # loop OUTSIDE the worker's task-execution span context, so
+            # the proxy/router trace must ride the request metadata.
+            with span("serve.replica",
+                      parent=(metadata or {}).get("traceparent"),
+                      attributes={"deployment": self.deployment_name,
+                                  "replica": self.replica_id,
+                                  "method": method_name,
+                                  "component": "replica"}):
+                target = (self._instance if method_name == "__call__"
+                          else None)
+                method = (getattr(self._instance, method_name)
+                          if target is None else self._resolve_call())
+                if inspect.iscoroutinefunction(method):
+                    return await method(*args, **kwargs)
+                # Sync user code must not block the replica's event loop.
+                return await asyncio.to_thread(method, *args, **kwargs)
+        except BaseException:
+            status = "error"
+            raise
         finally:
             self._ongoing -= 1
+            if metrics is not None:
+                try:
+                    metrics["processed"].inc(
+                        1, tags={**self._metric_tags(), "status": status})
+                    metrics["latency"].observe(
+                        time.perf_counter() - t0,
+                        tags=self._metric_tags())
+                    metrics["ongoing"].set(self._ongoing,
+                                           tags=self._metric_tags())
+                except Exception:
+                    pass
             if token is not None:
                 from ray_tpu.serve.multiplex import _request_model_id
 

@@ -130,36 +130,78 @@ class Router:
                model_id: Optional[str] = None):
         """Pick a replica and submit; returns (replica_id, ObjectRef).
         Blocks (with backoff) while the deployment has no running
-        replica — e.g. mid-startup."""
+        replica — e.g. mid-startup.
+
+        Observability: the assignment runs inside a `serve.router` span
+        (child of the ingress's ambient span), and the span's traceparent
+        rides the request metadata so the replica's span — in another
+        process — parents to it: one trace id covers
+        proxy -> router -> replica."""
+        from ray_tpu.util.tracing import current_traceparent, span
+
         deadline = time.monotonic() + timeout_s
         self._refresh()
-        while True:
-            try:
-                replica_id, handle = self._choose(model_id)
-                break
-            except _NoReplicas:
-                if time.monotonic() > deadline:
-                    from ray_tpu.serve.exceptions import (
-                        DeploymentUnavailableError)
+        with span("serve.router",
+                  attributes={"deployment": self.deployment_name,
+                              "component": "router"}):
+            # Queued = requests INSIDE assign that have no replica yet —
+            # the signal that matters during overload/startup (an
+            # autoscaler reading this must see the backlog, not the
+            # already-executing requests, which the replicas' ongoing
+            # gauge covers). Counted process-wide: several Routers can
+            # serve one deployment (one per handle).
+            from ray_tpu.serve._private.metrics import queued_delta
 
-                    raise DeploymentUnavailableError(
-                        f"no running replicas for "
-                        f"{self.deployment_name!r} after {timeout_s}s")
-                time.sleep(0.05)
-                self._refresh(force=True)
-        with self._lock:
-            self._inflight[replica_id] = \
-                self._inflight.get(replica_id, 0) + 1
+            queued_delta(self.deployment_name, +1)
+            try:
+                while True:
+                    try:
+                        replica_id, handle = self._choose(model_id)
+                        break
+                    except _NoReplicas:
+                        if time.monotonic() > deadline:
+                            from ray_tpu.serve.exceptions import (
+                                DeploymentUnavailableError)
+
+                            raise DeploymentUnavailableError(
+                                f"no running replicas for "
+                                f"{self.deployment_name!r} after "
+                                f"{timeout_s}s")
+                        time.sleep(0.05)
+                        self._refresh(force=True)
+            finally:
+                queued_delta(self.deployment_name, -1)
+            with self._lock:
+                self._inflight[replica_id] = \
+                    self._inflight.get(replica_id, 0) + 1
+                if model_id:
+                    self._model_affinity[model_id] = replica_id
+            try:
+                from ray_tpu.serve._private.metrics import router_metrics
+
+                router_metrics()["assignments"].inc(
+                    1, tags={"deployment": self.deployment_name})
+            except Exception:
+                pass  # metrics must never fail the data path
+            metadata: Optional[dict] = None
             if model_id:
-                self._model_affinity[model_id] = replica_id
-        metadata = ({"multiplexed_model_id": model_id}
-                    if model_id else None)
-        if metadata is not None:
-            ref = handle.handle_request.remote(method_name, args, kwargs,
-                                               metadata)
-        else:
-            ref = handle.handle_request.remote(method_name, args, kwargs)
+                metadata = {"multiplexed_model_id": model_id}
+            traceparent = current_traceparent()
+            if traceparent:
+                metadata = dict(metadata or {})
+                metadata["traceparent"] = traceparent
+            if metadata is not None:
+                ref = handle.handle_request.remote(method_name, args,
+                                                   kwargs, metadata)
+            else:
+                ref = handle.handle_request.remote(method_name, args,
+                                                   kwargs)
         return replica_id, ref
+
+    def inflight_snapshot(self) -> Dict[str, int]:
+        """Per-replica in-flight counts (dashboard /api/serve)."""
+        with self._lock:
+            return dict(self._inflight)
 
     def complete(self, replica_id: str) -> None:
         with self._lock:

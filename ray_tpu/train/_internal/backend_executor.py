@@ -9,6 +9,7 @@ workers -> poll results in lockstep -> surface gang failures.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
@@ -18,6 +19,31 @@ from ray_tpu.train._internal.worker_group import WorkerGroup
 from ray_tpu.train.backend import BackendConfig
 
 logger = logging.getLogger(__name__)
+
+def _round_metrics() -> Dict[str, Any]:
+    """Driver-side train telemetry instruments (lazy: registered in
+    whichever process drives the gang — the driver or a Tune trial
+    actor — both of which push to their raylet)."""
+    from ray_tpu.util.metrics import Gauge, Histogram, get_instruments
+
+    def build():
+        return {
+            "round": Histogram(
+                "train_round_time_seconds",
+                "Wall time between lockstep result rounds (driver view)",
+                boundaries=[0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0],
+                tag_keys=("trial",)),
+            "workers": Gauge(
+                "train_gang_workers",
+                "Workers in the live training gang",
+                tag_keys=("trial",)),
+            "step": Gauge(
+                "train_last_step_time_seconds",
+                "Rank-0 step time of the most recent report",
+                tag_keys=("trial", "phase")),
+        }
+
+    return get_instruments("train.executor", build)
 
 
 class TrainingWorkerError(Exception):
@@ -33,6 +59,11 @@ class BackendExecutor:
         self._scaling = scaling_config or ScalingConfig()
         self.worker_group: Optional[WorkerGroup] = None
         self._owned_pg = None  # PG we created (removed on shutdown)
+        self._trial_name = "default"
+        self._last_round_t: Optional[float] = None
+        # Aggregated view of the gang's most recent telemetry, served to
+        # callers (trainer result.json, dashboard /api/train).
+        self.last_telemetry: Optional[Dict[str, Any]] = None
 
     def start(self, placement_group=None) -> None:
         if placement_group is None:
@@ -75,6 +106,13 @@ class BackendExecutor:
                        dataset_shards: Optional[List[Any]] = None) -> None:
         wg = self.worker_group
         assert wg is not None, "call start() first"
+        self._trial_name = trial_name or "default"
+        self._last_round_t = None
+        try:
+            _round_metrics()["workers"].set(
+                len(wg), tags={"trial": self._trial_name})
+        except Exception:
+            pass
         self._backend.on_training_start(wg, self._backend_config)
         # rank bookkeeping: workers are already sorted by (node, pid)
         node_order: List[str] = []
@@ -125,6 +163,7 @@ class BackendExecutor:
         # callers can pick rank 0 even on mixed finish/report rounds.
         for rank, r in enumerate(results):
             r.setdefault("world_rank", rank)
+        self._record_round_telemetry(results)
         done = [r for r in results if r.get("type") == "done"]
         if len(done) == len(results):
             return None
@@ -132,6 +171,37 @@ class BackendExecutor:
             # Mixed finish/report: drive remaining workers to completion.
             return [r for r in results if r.get("type") != "done"] or None
         return results
+
+    def _record_round_telemetry(self, results: List[Dict[str, Any]]
+                                ) -> None:
+        """Fold one round's worker telemetry into driver-side metrics:
+        the round wall time (driver view) plus rank 0's step split from
+        the session's report metadata."""
+        try:
+            now = time.perf_counter()
+            metrics = _round_metrics()
+            tags = {"trial": self._trial_name}
+            if self._last_round_t is not None:
+                metrics["round"].observe(now - self._last_round_t,
+                                         tags=tags)
+            self._last_round_t = now
+            tele = [r.get("telemetry") for r in results
+                    if r.get("telemetry")]
+            if not tele:
+                return
+            lead = min(tele, key=lambda t: t.get("world_rank", 1 << 30))
+            for phase in ("step_time", "data_wait", "collective",
+                          "compute"):
+                metrics["step"].set(
+                    lead.get(f"{phase}_s", 0.0),
+                    tags={"trial": self._trial_name, "phase": phase})
+            self.last_telemetry = {
+                "workers": len(results), "lead": dict(lead),
+                "mean_step_time_s": sum(
+                    t.get("step_time_s", 0.0) for t in tele) / len(tele),
+            }
+        except Exception:
+            pass  # telemetry must never fail a training round
 
     def _probe_worker_liveness(self) -> None:
         """Ping every worker actor; a dead one raises TrainingWorkerError.
@@ -163,6 +233,11 @@ class BackendExecutor:
                 pass
 
     def shutdown(self) -> None:
+        # The driving process may exit right after fit(): push its
+        # train_* series now rather than waiting an interval.
+        from ray_tpu.util.metrics import flush_metrics_push
+
+        flush_metrics_push()
         if self.worker_group is not None:
             try:
                 self._backend.on_shutdown(self.worker_group,

@@ -86,6 +86,13 @@ class TrainWorker:
                 session.finish()
             except BaseException as e:  # noqa: BLE001
                 session.finish(error=e)
+            finally:
+                # The gang is killed right after results drain: push the
+                # final train_* histogram state to the raylet now or the
+                # last steps never reach the dashboard's /metrics.
+                from ray_tpu.util.metrics import flush_metrics_push
+
+                flush_metrics_push()
 
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="train-loop")

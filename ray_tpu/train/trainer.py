@@ -124,7 +124,13 @@ class JaxTrainer:
                                key=lambda r: r.get("world_rank", 1 << 30))
                     metrics = lead.get("metrics", {})
                     history.append(metrics)
-                    self._append_result(exp_dir, metrics)
+                    # Step telemetry (session report metadata) rides the
+                    # persisted line only — user-visible metrics stay
+                    # exactly what the train loop reported.
+                    line = dict(metrics)
+                    if lead.get("telemetry"):
+                        line["_telemetry"] = lead["telemetry"]
+                    self._append_result(exp_dir, line)
                     ckpt = next((r.get("checkpoint") for r in results
                                  if r.get("checkpoint") is not None), None)
                     if ckpt is not None:

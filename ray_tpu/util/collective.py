@@ -255,6 +255,31 @@ def _torch_op(op: str):
 # ---------------------------------------------------------------------------
 # collectives
 # ---------------------------------------------------------------------------
+def _timed_collective(fn):
+    """Attribute each eager collective's wall time to the active
+    training step (air/session step telemetry: the `collective` split).
+    No-op outside a train loop; in-graph XLA collectives (psum under
+    jit) are invisible here by design — they're compute to XLA."""
+    import functools
+    import time as _time
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        t0 = _time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            try:
+                from ray_tpu.air.session import _record_collective
+
+                _record_collective(_time.perf_counter() - t0)
+            except Exception:
+                pass
+
+    return wrapped
+
+
+@_timed_collective
 def allreduce(tensor, group_name: str = "default",
               op: str = ReduceOp.SUM):
     """All-reduce; returns the reduced array (same array type as input).
@@ -271,6 +296,7 @@ def allreduce(tensor, group_name: str = "default",
     return _from_torch(t, tensor)
 
 
+@_timed_collective
 def allgather(tensor, group_name: str = "default") -> List[Any]:
     """Gathers every rank's tensor; returns a list of arrays in rank
     order."""
@@ -291,7 +317,11 @@ def reducescatter(tensor, group_name: str = "default",
                   op: str = ReduceOp.SUM):
     """Reduce-scatter along axis 0: rank i receives slice i of the
     reduction. Gloo lacks a native reducescatter; reduce+slice matches
-    the reference's pygloo fallback."""
+    the reference's pygloo fallback.
+
+    Deliberately NOT @_timed_collective: it delegates to the decorated
+    allreduce, which records the communication time — decorating both
+    would double-count the step's collective split."""
     group = _require(group_name)
     reduced = allreduce(tensor, group_name, op)
     n = group.world_size
@@ -303,6 +333,7 @@ def reducescatter(tensor, group_name: str = "default",
     return reduced[group.rank * chunk:(group.rank + 1) * chunk]
 
 
+@_timed_collective
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     group = _require(group_name)
     if group.backend == "ici":
@@ -319,6 +350,7 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     return _from_torch(t, tensor)
 
 
+@_timed_collective
 def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
            op: str = ReduceOp.SUM):
     group = _require_gloo(group_name, "reduce")
@@ -332,6 +364,7 @@ def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
     return _from_torch(t, tensor)
 
 
+@_timed_collective
 def barrier(group_name: str = "default") -> None:
     group = _require(group_name)
     if group.backend == "ici":

@@ -190,6 +190,24 @@ def default_registry() -> MetricsRegistry:
         return _default_registry
 
 
+_instrument_cache: Dict[str, Any] = {}
+_instrument_cache_lock = threading.Lock()
+
+
+def get_instruments(key: str, build):
+    """Build-once instrument set per process, shared by every subsystem
+    (serve proxies/router/replica, train session/executor, data
+    shuffle). Constructing the same instrument twice would shadow the
+    first registration in the registry, so first use is locked."""
+    inst = _instrument_cache.get(key)
+    if inst is None:
+        with _instrument_cache_lock:
+            inst = _instrument_cache.get(key)
+            if inst is None:
+                inst = _instrument_cache[key] = build()
+    return inst
+
+
 # ---------------------------------------------------------------------------
 # Prometheus text exposition (consumed by the dashboard /metrics endpoint).
 # ---------------------------------------------------------------------------
@@ -272,12 +290,15 @@ class _PushState:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval):
-            try:
-                snap = default_registry().snapshot()
-                if snap:
-                    self._push(snap)
-            except Exception:
-                pass  # raylet briefly unreachable: drop this interval
+            self.flush_now()
+
+    def flush_now(self) -> None:
+        try:
+            snap = default_registry().snapshot()
+            if snap:
+                self._push(snap)
+        except Exception:
+            pass  # raylet briefly unreachable: drop this push
 
     def stop(self) -> None:
         self._stop.set()
@@ -297,3 +318,13 @@ def stop_metrics_push() -> None:
     if _push_state is not None:
         _push_state.stop()
         _push_state = None
+
+
+def flush_metrics_push() -> None:
+    """Push the current snapshot NOW (bypassing the interval). Used by
+    short-lived processes — e.g. training workers at gang shutdown —
+    whose final observations would otherwise die with the process
+    before the next periodic push."""
+    st = _push_state
+    if st is not None:
+        st.flush_now()

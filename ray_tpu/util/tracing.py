@@ -36,11 +36,14 @@ _ctx: contextvars.ContextVar = contextvars.ContextVar(
     "ray_tpu_trace_ctx", default=None)   # (trace_id, span_id)
 
 
+_flusher: Optional[threading.Thread] = None
+
+
 def enable_tracing(out_dir: Optional[str] = None) -> None:
     """Turn span recording on (reference: `ray.init(_tracing_startup_hook)`
     / RAY_TRACING_ENABLED). Workers inherit via the runtime-env
     RAY_TPU_TRACE_DIR variable set by the driver."""
-    global _enabled, _dir
+    global _enabled, _dir, _flusher
     _enabled = True
     if out_dir is None:
         out_dir = os.environ.get("RAY_TPU_TRACE_DIR") or os.path.join(
@@ -48,6 +51,26 @@ def enable_tracing(out_dir: Optional[str] = None) -> None:
     os.makedirs(out_dir, exist_ok=True)
     _dir = out_dir
     os.environ["RAY_TPU_TRACE_DIR"] = out_dir
+    if _flusher is None or not _flusher.is_alive():
+        # Spans must reach disk without waiting for _FLUSH_AT: a serve
+        # replica records a handful of spans per request and another
+        # process's collect() cannot flush this one's buffer. Daemon
+        # timer + atexit cover both long-lived and exiting processes.
+        import atexit
+
+        atexit.register(flush)
+
+        def _loop():
+            while _enabled:
+                time.sleep(0.5)
+                try:
+                    flush()
+                except Exception:
+                    pass
+
+        _flusher = threading.Thread(target=_loop, daemon=True,
+                                    name="trace-flush")
+        _flusher.start()
 
 
 def tracing_enabled() -> bool:

@@ -50,6 +50,43 @@ class TestZip:
         total = sum(r["x"] + r["y"] for r in a.zip(b).iter_rows())
         assert total == sum(i + i + 1 for i in range(20))
 
+    def test_transforms_after_zip_keep_partner(self):
+        """Regression (ADVICE r5): map/map_batches/filter applied AFTER
+        zip must see the merged columns, not silently drop the partner."""
+        a = rdata.range(20).map_batches(lambda b: {"x": b["id"]})
+        b = rdata.range(20).map_batches(lambda b: {"y": b["id"] * 10})
+        z = a.zip(b).map(lambda r: {"s": r["x"] + r["y"]})
+        rows = z.take_all()
+        assert [r["s"] for r in rows] == [i + 10 * i for i in range(20)]
+        # map_batches sees both columns too
+        zb = a.zip(b).map_batches(lambda blk: {"m": blk["x"] * blk["y"]})
+        assert [int(r["m"]) for r in zb.take_all()] == [
+            i * 10 * i for i in range(20)]
+        # filter on a partner column
+        zf = a.zip(b).filter(lambda r: r["y"] >= 100)
+        assert len(zf.take_all()) == 10
+
+    def test_zip_chains(self):
+        a = rdata.range(10).map_batches(lambda b: {"x": b["id"]})
+        b = rdata.range(10).map_batches(lambda b: {"y": b["id"] + 1})
+        c = rdata.range(10).map_batches(lambda b: {"z": b["id"] + 2})
+        rows = a.zip(b).zip(c).take_all()
+        assert set(rows[0]) == {"x", "y", "z"}
+        assert all(r["y"] == r["x"] + 1 and r["z"] == r["x"] + 2
+                   for r in rows)
+
+    def test_zip_then_limit_keeps_partner(self):
+        a = rdata.range(20).map_batches(lambda b: {"x": b["id"]})
+        b = rdata.range(20).map_batches(lambda b: {"y": b["id"] + 1})
+        rows = a.zip(b).limit(5).take_all()
+        assert len(rows) == 5 and set(rows[0]) == {"x", "y"}
+
+    def test_zip_actor_stage_rejected(self):
+        a = rdata.range(10)
+        b = rdata.range(10)
+        with pytest.raises(NotImplementedError, match="actors"):
+            a.zip(b).map_batches(lambda blk: blk, compute="actors")
+
 
 # -- join ----------------------------------------------------------------
 

@@ -80,3 +80,48 @@ def test_gcs_restart_cluster_heals(ray_cluster):
             break
         time.sleep(0.5)
     assert alive, "no alive nodes after GCS restart"
+
+
+def test_wal_torn_tail_truncated_before_new_appends(tmp_path):
+    """Regression (ADVICE r5 high): a crash mid-append leaves a partial
+    frame at the WAL tail. _load_storage must truncate to the last
+    complete frame BEFORE reopening in append mode — otherwise frames
+    fsynced+acked after the torn one are unreachable to every future
+    replay, silently dropping durable writes on the SECOND restart."""
+    import asyncio
+    import os
+
+    from ray_tpu.core.gcs.server import GcsServer
+
+    path = str(tmp_path / "gcs.db")
+
+    async def put(srv, k, v):
+        srv.kv[k] = v
+        srv.mark_dirty("kv", k)
+        await srv.flush_now()
+
+    async def scenario():
+        # Epoch 1: one durable write, then crash mid-append (torn tail).
+        a = GcsServer(storage_path=path)
+        a._load_storage()
+        await put(a, "k1", b"v1")
+        with open(path + ".wal", "ab") as f:
+            f.write(b"\x40\x00\x00\x00partial")  # header says 64B, has 7
+
+        # Epoch 2: replay stops at the torn frame, truncates, and a NEW
+        # acked write lands after it.
+        b = GcsServer(storage_path=path)
+        b._load_storage()
+        assert b.kv.get("k1") == b"v1"
+        wal_size = os.path.getsize(path + ".wal")
+        await put(b, "k2", b"v2")
+        assert os.path.getsize(path + ".wal") > wal_size
+
+        # Epoch 3: BOTH acked writes must replay.
+        c = GcsServer(storage_path=path)
+        c._load_storage()
+        assert c.kv.get("k1") == b"v1"
+        assert c.kv.get("k2") == b"v2", (
+            "acked write after a torn tail was silently dropped")
+
+    asyncio.run(scenario())

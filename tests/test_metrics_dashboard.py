@@ -143,3 +143,107 @@ def test_dashboard_api_and_cluster_metrics(ray_cluster, tmp_path):
     status, body = _get(base + "/api/objects")
     assert status == 200
     assert isinstance(json.loads(body), list)
+
+
+def _poll_metrics(base, needle, timeout=40):
+    import time
+
+    deadline = time.time() + timeout
+    text = ""
+    while time.time() < deadline:
+        _, text = _get(base + "/metrics")
+        if needle in text:
+            return text
+        time.sleep(1.0)
+    return text
+
+
+def test_serve_request_metrics_reach_dashboard(ray_cluster):
+    """Acceptance: /metrics exposes serve_* latency histograms after
+    requests flow, and /api/serve aggregates per-deployment state."""
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    base = _dashboard_url(ray_tpu)
+    try:
+        @serve.deployment
+        class Ping:
+            def __call__(self, payload):
+                return {"pong": True}
+
+        serve.run(Ping.bind(), name="ping", route_prefix="/ping")
+        port = serve.start()
+        for _ in range(5):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/ping", timeout=60) as r:
+                assert r.status == 200
+
+        text = _poll_metrics(base,
+                             "serve_deployment_processing_latency_seconds")
+        assert "serve_deployment_processing_latency_seconds_bucket" \
+            in text, text[:2000]
+        assert "serve_request_latency_seconds_bucket" in text
+        assert 'serve_num_requests{ingress="http"' in text
+        assert "serve_deployment_processed_queries" in text
+
+        status, body = _get(base + "/api/serve")
+        assert status == 200
+        state = json.loads(body)
+        dep = state["deployments"].get("Ping")
+        assert dep is not None, state
+        assert dep["processed"] >= 5
+        assert dep["latency_p50_s"] is not None
+        assert state["ingress"]["requests"].get("http", 0) >= 5
+    finally:
+        serve.shutdown()
+
+
+def _telemetry_train_loop(config):
+    import time
+
+    from ray_tpu import train
+
+    shard = train.get_dataset_shard("train")
+    for _ in range(config["steps"]):
+        if shard is not None:
+            for _b in shard.iter_batches(batch_size=64):
+                pass
+        time.sleep(0.02)
+        train.report({"loss": 1.0})
+
+
+def test_train_step_telemetry_reaches_dashboard(ray_cluster):
+    """Acceptance: train_* step-time series appear in /metrics; the
+    /api/train endpoint aggregates the per-trial step split."""
+    import ray_tpu
+    from ray_tpu import data
+    from ray_tpu.train import JaxConfig, JaxTrainer, RunConfig, ScalingConfig
+
+    base = _dashboard_url(ray_tpu)
+    trainer = JaxTrainer(
+        _telemetry_train_loop,
+        train_loop_config={"steps": 4},
+        jax_config=JaxConfig(platform="cpu"),
+        scaling_config=ScalingConfig(num_workers=2),
+        datasets={"train": data.range(512, parallelism=4)},
+        run_config=RunConfig(name="telemetry_probe",
+                             storage_path="/tmp/rt_train_obs"))
+    result = trainer.fit()
+    assert result.error is None
+
+    text = _poll_metrics(base, "train_step_time_seconds")
+    assert "train_step_time_seconds_bucket" in text, text[:2000]
+    assert "train_data_wait_seconds" in text
+    assert "train_compute_seconds" in text
+    assert 'trial="telemetry_probe"' in text
+
+    status, body = _get(base + "/api/train")
+    assert status == 200
+    state = json.loads(body)
+    trial = state["trials"].get("telemetry_probe")
+    assert trial is not None, state
+    assert trial["steps"] >= 4 * 2  # 4 steps x 2 workers
+    assert trial["breakdown_s"].get("step_time", 0) > 0
+    assert "data_wait" in trial["breakdown_s"]

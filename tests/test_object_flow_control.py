@@ -72,6 +72,62 @@ class TestPullManager:
 
         self._run(go())
 
+    def test_cancelled_waiter_does_not_leak_budget(self):
+        """Regression (ADVICE r5): a cancelled queued admit must not be
+        charged by a later release — that would permanently shrink the
+        budget and eventually wedge all inbound transfers."""
+        async def go():
+            pm = _PullManager(10)
+            g1 = await pm.admit(8)
+            waiter = asyncio.ensure_future(pm.admit(5))
+            await asyncio.sleep(0.01)
+            waiter.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await waiter
+            pm.release(g1)
+            assert pm.in_use == 0
+            # The FULL budget must still be grantable afterwards.
+            g2 = await asyncio.wait_for(pm.admit(10), timeout=1.0)
+            pm.release(g2)
+            assert pm.in_use == 0
+
+        self._run(go())
+
+    def test_cancel_after_grant_returns_bytes(self):
+        async def go():
+            pm = _PullManager(10)
+            g1 = await pm.admit(8)
+            waiter = asyncio.ensure_future(pm.admit(5))
+            await asyncio.sleep(0.01)
+            pm.release(g1)     # grants the waiter (event set)...
+            waiter.cancel()    # ...but it is cancelled before resuming
+            with pytest.raises(asyncio.CancelledError):
+                await waiter
+            assert pm.in_use == 0
+            g2 = await asyncio.wait_for(pm.admit(10), timeout=1.0)
+            pm.release(g2)
+            assert pm.in_use == 0
+
+        self._run(go())
+
+    def test_dead_entries_do_not_block_fresh_admits(self):
+        async def go():
+            pm = _PullManager(10)
+            g1 = await pm.admit(10)
+            w1 = asyncio.ensure_future(pm.admit(4))
+            await asyncio.sleep(0.01)
+            w1.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await w1
+            pm.release(g1)
+            # Heap may hold only dead entries now; a fresh admit must
+            # take the fast path, not queue forever.
+            g2 = await asyncio.wait_for(pm.admit(10), timeout=1.0)
+            pm.release(g2)
+            assert pm.in_use == 0
+
+        self._run(go())
+
 
 @pytest.fixture(scope="module")
 def broadcast_cluster():
