@@ -73,7 +73,11 @@ def main() -> None:
     mfu = (6.0 * n_params * tokens_per_sec) / peak
 
     # Runtime microbench (ray_perf equivalent): folded into the same JSON
-    # line as `notes` so the driver's one-line contract holds.
+    # line as `notes` so the driver's one-line contract holds. Includes
+    # the compiled-graph micro-bench — a 3-actor chain via
+    # experimental_compile().execute() vs the same chain through
+    # dag.execute()'s per-task path (`cgraph_call_ms`,
+    # `dag_chain_call_ms`, `cgraph_vs_dag_speedup`).
     notes = {}
     try:
         import os

@@ -496,6 +496,9 @@ class ClusterRuntime:
             pass
         self._shm.close()
         self._exec_pool.shutdown(wait=False, cancel_futures=True)
+        pool = getattr(self, "_cgraph_deposit_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
         if self._node is not None:
             self._node.stop()
         self._loop.stop()
@@ -1812,6 +1815,11 @@ class ClusterRuntime:
                                   pinned: Optional[List[ObjectID]] = None
                                   ) -> None:
         aid = spec["actor_id"]
+        # Per-task retry budget for SYSTEM failures (reference:
+        # direct_actor_task_submitter.h — client queues resubmit through
+        # an actor restart when max_task_retries allows; -1 = infinite).
+        state = self._actors.get(aid)
+        retries_left = state.task_retries if state is not None else 0
         try:
             if spec["task_id"] in self._cancel_requested:
                 # Cancelled before the push left this process: resolve the
@@ -1826,30 +1834,56 @@ class ClusterRuntime:
                 except Exception:
                     pass  # 60s gate timeout is the backstop
                 return
-            client = await self._actor_client(aid)
-            state = self._actors.get(aid)
-            if state is not None and state.address:
-                self._inflight_task_workers[spec["task_id"]] = (
-                    state.address, True)
-            reply = await client.call(
-                "push_actor_task",
-                spec=to_wire(spec) if hasattr(spec, "_wire_name") else spec,
-                timeout=None)
-            self._record_task_reply(spec, reply)
-        except RayActorError as e:
-            self._fail_actor_task(spec, refs, e)
-        except (ConnectionLost, RpcError) as e:
-            # In-flight calls fail when the actor dies (reference semantics:
-            # no implicit replay without max_task_retries); the restart, if
-            # allowed, proceeds in the background for future calls.
-            state = self._actors.get(aid)
-            if state is not None:
-                state.state = "RESTARTING"
-                state.address = None
-                asyncio.ensure_future(self._maybe_restart_actor(state))
-            self._fail_actor_task(
-                spec, refs,
-                ActorDiedError(error_msg=f"actor connection lost: {e}"))
+            while True:
+                pushed_addr = None
+                try:
+                    client = await self._actor_client(aid)
+                    state = self._actors.get(aid)
+                    if state is not None and state.address:
+                        pushed_addr = state.address
+                        self._inflight_task_workers[spec["task_id"]] = (
+                            state.address, True)
+                    reply = await client.call(
+                        "push_actor_task",
+                        spec=(to_wire(spec) if hasattr(spec, "_wire_name")
+                              else spec),
+                        timeout=None)
+                    self._record_task_reply(spec, reply)
+                    return
+                except RayActorError as e:
+                    self._fail_actor_task(spec, refs, e)
+                    return
+                except (ConnectionLost, RpcError) as e:
+                    state = self._actors.get(aid)
+                    if (state is not None and state.state == "ALIVE"
+                            and (pushed_addr is None
+                                 or state.address == pushed_addr)):
+                        # We are first to observe this death; a concurrent
+                        # handler that already restarted the actor (fresh
+                        # address) must not be knocked back to RESTARTING.
+                        state.state = "RESTARTING"
+                        state.address = None
+                    if state is None or retries_left == 0:
+                        # No retry budget: fail the call, restart (if
+                        # allowed) in the background for FUTURE calls.
+                        if state is not None:
+                            asyncio.ensure_future(
+                                self._maybe_restart_actor(state))
+                        self._fail_actor_task(
+                            spec, refs, ActorDiedError(
+                                error_msg=f"actor connection lost: {e}"))
+                        return
+                    if retries_left > 0:
+                        retries_left -= 1
+                    if not await self._restart_and_wait(state):
+                        self._fail_actor_task(
+                            spec, refs, ActorDiedError(
+                                error_msg="actor died and could not be "
+                                          f"restarted: {e}"))
+                        return
+                    # Actor is ALIVE again: resubmit this task to the new
+                    # incarnation (same seq; the fresh worker adopts the
+                    # first seq it sees).
         except Exception as e:  # noqa: BLE001
             self._fail_actor_task(
                 spec, refs, RayActorError(error_msg=str(e)))
@@ -1858,6 +1892,22 @@ class ClusterRuntime:
             self._cancel_requested.discard(spec["task_id"])
             if pinned:
                 self._unpin_args(pinned)
+
+    async def _restart_and_wait(self, state: "_ActorState",
+                                timeout: float = 120.0) -> bool:
+        """Drive (or wait out a concurrent) actor restart; True when the
+        actor is ALIVE again. Runs on the single RPC event loop, so the
+        restart_inflight check-then-act below cannot interleave."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if state.state == "ALIVE":
+                return True
+            if state.state == "DEAD":
+                return False
+            if not state.restart_inflight:
+                return await self._maybe_restart_actor(state)
+            await asyncio.sleep(0.05)
+        return state.state == "ALIVE"
 
     async def _maybe_restart_actor(self, state: Optional[_ActorState]
                                    ) -> bool:
@@ -2938,6 +2988,35 @@ class ClusterRuntime:
                 entry["cond"].notify_all()
 
         asyncio.ensure_future(notify())
+
+    async def handle_cgraph_push(self, conn: ServerConnection, *,
+                                 channel: str, data: bytes, seq: int = 0,
+                                 capacity: int = 8, kind: str = "obj",
+                                 ordered: bool = True) -> bool:
+        """Compiled-graph channel deposit (reference: the shared-memory
+        channel write in ray/experimental/channel/). The reader process
+        hosts the slot buffer; this handler admits one pushed frame in
+        writer order. The deposit blocks while the slot is full — the
+        delayed reply IS the writer's backpressure — so it runs on an
+        executor thread, never on the RPC loop."""
+        from ray_tpu.cgraph.channel import deposit_nowait, deposit_remote
+
+        if deposit_nowait(kind, channel, capacity, data, seq,
+                          ordered=ordered):
+            return True   # free slot, in-order frame: no thread hop
+        # Dedicated pool: a full channel parks its deposit thread for up
+        # to the push timeout — on the shared default executor that would
+        # head-of-line-block unrelated work (generator pushes, to_thread).
+        pool = getattr(self, "_cgraph_deposit_pool", None)
+        if pool is None:
+            pool = self._cgraph_deposit_pool = (
+                concurrent.futures.ThreadPoolExecutor(
+                    max_workers=32, thread_name_prefix="cgraph-deposit"))
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            pool,
+            lambda: deposit_remote(kind, channel, capacity, data, seq,
+                                   ordered=ordered))
 
     async def handle_exit_worker(self, conn: ServerConnection) -> bool:
 

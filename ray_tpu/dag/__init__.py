@@ -48,6 +48,17 @@ class DAGNode:
         """Execute the graph; returns the root's ObjectRef/handle."""
         return self._execute_memo({}, input_value)
 
+    def experimental_compile(self, *, max_in_flight: int = 8,
+                             channel_capacity: Optional[int] = None):
+        """Compile a static actor-method DAG into persistent per-actor
+        execution loops connected by reusable channels (reference:
+        `ray/dag/compiled_dag_node.py` experimental_compile). Returns a
+        `ray_tpu.cgraph.CompiledDAG`: `execute(x)` costs channel writes
+        instead of per-node task submissions."""
+        from ray_tpu.cgraph import compile_dag
+        return compile_dag(self, max_in_flight=max_in_flight,
+                           channel_capacity=channel_capacity)
+
 
 class InputNode(DAGNode):
     """Placeholder for the runtime input (reference: dag/input_node.py).
@@ -117,6 +128,17 @@ class ClassMethodNode(DAGNode):
         self._actor = actor_or_node
         self._method_name = method_name
         self._options = options
+        self._channel_kind = "obj"
+
+    def with_channel(self, kind: str) -> "ClassMethodNode":
+        """Select the compiled-graph channel type carrying THIS node's
+        result (reference: `with_type_hint(TorchTensorType())`).
+        `"array"` keeps jax arrays on device for co-located consumers
+        and re-lands host bytes on device across processes."""
+        if kind not in ("obj", "array"):
+            raise ValueError(f"unknown channel kind {kind!r}")
+        self._channel_kind = kind
+        return self
 
     def _children(self):
         out = super()._children()
@@ -135,5 +157,21 @@ class ClassMethodNode(DAGNode):
         return getattr(actor, self._method_name).remote(*args, **kwargs)
 
 
+class MultiOutputNode(DAGNode):
+    """Groups several nodes as the DAG's outputs (reference:
+    `ray/dag/output_node.py`): `execute` / compiled `execute` return a
+    list with one entry per output."""
+
+    def __init__(self, outputs):
+        outputs = tuple(outputs)
+        if not outputs:
+            raise ValueError("MultiOutputNode needs at least one output")
+        super().__init__(outputs, {})
+
+    def _execute_impl(self, memo, input_value):
+        args, _ = self._resolve_args(memo, input_value)
+        return list(args)
+
+
 __all__ = ["DAGNode", "InputNode", "FunctionNode", "ClassNode",
-           "ClassMethodNode"]
+           "ClassMethodNode", "MultiOutputNode"]

@@ -29,16 +29,33 @@ logger = logging.getLogger(__name__)
 class _MapWorker:
     """Actor wrapping the user's callable (class instance or function)."""
 
-    def __init__(self, fn_or_cls, ctor_args, ctor_kwargs):
+    def __init__(self, fn_or_cls, ctor_args, ctor_kwargs,
+                 worker_index: int = 0):
         import inspect
 
         if inspect.isclass(fn_or_cls):
             self._fn = fn_or_cls(*ctor_args, **(ctor_kwargs or {}))
         else:
             self._fn = fn_or_cls
+        self._worker_index = worker_index
 
     def apply(self, block):
         return self._fn(block)
+
+    def apply_timed(self, block):
+        """Like `apply`, but ships the replica's wall time back with the
+        block (the `_run_chain_timed` pattern) so `Dataset.stats()` can
+        report per-replica operator timing for actor-pool stages."""
+        import time
+
+        from ray_tpu.data.stats import block_rows_bytes
+
+        t0 = time.perf_counter()
+        out = self._fn(block)
+        dt = time.perf_counter() - t0
+        rows, nbytes = block_rows_bytes(out)
+        return {"block": out, "replica": self._worker_index,
+                "ops": [("apply", dt, rows, nbytes)]}
 
 
 def _bake_block(task, transforms):
@@ -72,9 +89,12 @@ class ActorPoolStage:
         self.num_tpus = num_tpus
         self.window = max_tasks_in_flight_per_actor
 
-    def run(self, read_tasks, transforms, block_refs):
+    def run(self, read_tasks, transforms, block_refs, stats=None):
         """Stream mapped blocks in input order. Generator: lazy, bounded
-        in-flight, actors torn down on close/exhaustion."""
+        in-flight, actors torn down on close/exhaustion. With `stats`,
+        replicas ship their apply wall time back next to each block and
+        per-replica operator entries land in the report
+        (`actor_pool_map[replica=N]`)."""
         import ray_tpu
         from ray_tpu.util.actor_pool import ActorPool
 
@@ -88,13 +108,19 @@ class ActorPoolStage:
         if self.num_tpus:
             resources["num_tpus"] = self.num_tpus
         worker_cls = ray_tpu.remote(**resources)(_MapWorker)
+        timed = stats is not None
 
-        def spawn():
+        def spawn(index):
             return worker_cls.remote(self.fn, self.ctor_args,
-                                     self.ctor_kwargs)
+                                     self.ctor_kwargs, index)
 
-        actors = [spawn() for _ in range(self.min_actors)]
+        actors = [spawn(i) for i in range(self.min_actors)]
         pool = ActorPool(actors)
+
+        def submit_one(a, ref):
+            return (a.apply_timed.remote(ref) if timed
+                    else a.apply.remote(ref))
+
         try:
             submitted = 0
             yielded = 0
@@ -106,15 +132,25 @@ class ActorPoolStage:
                 backlog = n - submitted
                 if (backlog > target_inflight
                         and len(actors) < self.max_actors):
-                    fresh = spawn()
+                    fresh = spawn(len(actors))
                     actors.append(fresh)
                     pool.push(fresh)
                 while (submitted < n
                        and submitted - yielded < target_inflight):
-                    pool.submit(lambda a, ref: a.apply.remote(ref),
-                                refs[submitted])
+                    pool.submit(submit_one, refs[submitted])
                     submitted += 1
-                yield pool.get_next(timeout=600)
+                out = pool.get_next(timeout=600)
+                if timed and isinstance(out, dict) and "block" in out:
+                    replica = out.get("replica", 0)
+                    for name, dt, rows, nbytes in out.get("ops", ()):
+                        # Index 510+replica: distinct OpStats slot per
+                        # replica, after the coarse 500 stage entry.
+                        stats.record_op(
+                            510 + replica,
+                            f"actor_pool_map[replica={replica}]",
+                            dt, rows, nbytes)
+                    out = out["block"]
+                yield out
                 yielded += 1
         finally:
             for a in actors:

@@ -499,7 +499,8 @@ class Dataset:
         if self._actor_stage is not None:
             if ray_tpu.is_initialized():
                 blocks = self._actor_stage.run(
-                    self._read_tasks, self._transforms, self._block_refs)
+                    self._read_tasks, self._transforms, self._block_refs,
+                    stats=stats)
             else:
                 # No cluster: run the stage's callable in-process (one
                 # "replica"), keeping semantics identical for unit tests.

@@ -39,6 +39,11 @@ class _Counter:
         return self.n
 
 
+class _ChainStage:
+    def step(self, x):
+        return x + 1
+
+
 def run_microbench(local_mode: bool = False,
                    scale: float = 1.0) -> Dict[str, Any]:
     """Returns {metric: value} — throughputs in ops/s, latencies in ms."""
@@ -110,6 +115,42 @@ def run_microbench(local_mode: bool = False,
         time.sleep(0.1)  # segment-pool refill runs off the hot path
     out["put_10mb_ms"] = round(_p50(puts) * 1e3, 2)
     out["get_10mb_ms"] = round(_p50(gets) * 1e3, 2)
+
+    # 5. Compiled graphs vs lazy DAG: the same 3-actor chain through
+    # dag.execute (3 actor tasks/call) and experimental_compile
+    # (persistent loops + channels; no per-call task plane). Pipelined
+    # per-call cost with a bounded in-flight window — the serving shape
+    # the compiled plane exists for.
+    from ray_tpu.dag import InputNode
+
+    stage_cls = ray_tpu.remote(num_cpus=0)(_ChainStage)
+    stages = [stage_cls.remote() for _ in range(3)]
+    ray_tpu.get([s.step.remote(0) for s in stages], timeout=120)
+    with InputNode() as inp:
+        dag = stages[2].step.bind(
+            stages[1].step.bind(stages[0].step.bind(inp)))
+    n = max(1, int(200 * scale))
+
+    t0 = time.perf_counter()
+    ray_tpu.get([dag.execute(i) for i in range(n)], timeout=600)
+    dt = time.perf_counter() - t0
+    out["dag_chain_calls_per_s"] = round(n / dt, 1)
+    out["dag_chain_call_ms"] = round(dt / n * 1e3, 3)
+
+    compiled = dag.experimental_compile(max_in_flight=16)
+    ray_tpu.get(compiled.execute(0), timeout=120)  # warm the loops
+    t0 = time.perf_counter()
+    refs = [compiled.execute(i) for i in range(n)]
+    for r in refs:
+        ray_tpu.get(r, timeout=600)
+    dt = time.perf_counter() - t0
+    out["cgraph_calls_per_s"] = round(n / dt, 1)
+    out["cgraph_call_ms"] = round(dt / n * 1e3, 3)
+    out["cgraph_vs_dag_speedup"] = round(
+        out["dag_chain_call_ms"] / max(out["cgraph_call_ms"], 1e-9), 1)
+    compiled.teardown()
+    for s in stages:
+        ray_tpu.kill(s)
 
     ray_tpu.kill(counter)
     return out

@@ -34,6 +34,10 @@ def __getattr__(name):
         from ray_tpu.serve._private.grpc_proxy import GrpcServeClient
 
         return GrpcServeClient
+    if name == "compile_deployment_chain":
+        from ray_tpu.serve.cgraph import compile_deployment_chain
+
+        return compile_deployment_chain
     raise AttributeError(name)
 
 
@@ -41,7 +45,7 @@ __all__ = [
     "deployment", "run", "delete", "shutdown", "status",
     "get_app_handle", "get_deployment_handle", "batch",
     "multiplexed", "get_multiplexed_model_id", "start_grpc_ingress",
-    "GrpcServeClient",
+    "GrpcServeClient", "compile_deployment_chain",
     "Deployment", "Application", "DeploymentHandle",
     "DeploymentResponse", "AutoscalingConfig", "DeploymentConfig",
     "HTTPOptions", "RayServeException", "ReplicaDrainingError",

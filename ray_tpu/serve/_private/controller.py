@@ -453,21 +453,68 @@ class ServeController:
             pass
 
     # -- autoscaling ----------------------------------------------------
+    async def _collect_metric_snapshots(self) -> list:
+        """Every process's pushed app-metric snapshot: the local registry
+        (covers local mode, where proxies/routers/replicas share this
+        process) plus each alive raylet's merged worker snapshots
+        (cluster mode — the same feed the dashboard /metrics uses)."""
+        from ray_tpu.util.metrics import default_registry
+
+        snaps = list(default_registry().snapshot())
+        from ray_tpu.core.worker import current_runtime
+
+        rt = current_runtime()
+        if not getattr(rt, "is_local_mode", False):
+            try:
+                for n in await rt._gcs.get_nodes():
+                    if not n.get("alive"):
+                        continue
+                    try:
+                        client = await rt._raylet_client(n["address"])
+                        snaps.extend(await client.call("get_metrics",
+                                                       timeout=5.0))
+                    except Exception:
+                        continue
+            except Exception:
+                pass
+        return snaps
+
     async def _autoscale(self, state: _DeploymentState) -> None:
+        """Queue-length autoscaling driven by the data plane's OWN
+        gauges — `serve_replica_ongoing_requests` (per live replica) +
+        `serve_deployment_queued_queries` (per router process backlog) —
+        instead of an extra metrics.remote() poll per replica per tick
+        (the PR-2 follow-up in ROADMAP). The gauges lag by the metrics
+        push interval; upscale/downscale delays already absorb that. If
+        no gauge has been pushed yet for any live replica (fresh
+        deployment), fall back to one polling round."""
         cfg = state.config.autoscaling_config
         if cfg is None:
             return
         running = [r for r in state.replicas if r.state == "RUNNING"]
         if not running:
             return
-        total = 0
-        for r in running:
-            try:
-                m = await _aget(r.handle.metrics.remote(), timeout=2.0)
-                r.ongoing = m["ongoing"]
-                total += m["ongoing"]
-            except Exception:
-                pass
+        try:
+            snaps = await self._collect_metric_snapshots()
+        except Exception:
+            snaps = []
+        per_replica, queued = _deployment_load_from_samples(
+            snaps, state.name, [r.replica_id for r in running])
+        if per_replica:
+            total = queued
+            for r in running:
+                if r.replica_id in per_replica:
+                    r.ongoing = int(per_replica[r.replica_id])
+                total += per_replica.get(r.replica_id, 0)
+        else:
+            total = 0
+            for r in running:
+                try:
+                    m = await _aget(r.handle.metrics.remote(), timeout=2.0)
+                    r.ongoing = m["ongoing"]
+                    total += m["ongoing"]
+                except Exception:
+                    pass
         desired = math.ceil(total / max(cfg.target_ongoing_requests, 1e-9))
         desired = min(max(desired, cfg.min_replicas), cfg.max_replicas)
         now = time.monotonic()
@@ -488,6 +535,36 @@ class ServeController:
         else:
             state._scale_high_since = None
             state._scale_low_since = None
+
+
+def _deployment_load_from_samples(snapshots: list, deployment: str,
+                                  live_replica_ids: list):
+    """Fold metric snapshots into autoscaling inputs for one deployment.
+
+    Returns `(per_replica_ongoing, queued_total)`:
+    - `per_replica_ongoing`: replica_id -> latest
+      `serve_replica_ongoing_requests` gauge value, restricted to the
+      LIVE replica set (dead replicas' gauges linger in raylet snapshots
+      until worker eviction and must not count);
+    - `queued_total`: sum of `serve_deployment_queued_queries` across
+      router processes (each process aggregates its own backlog, so the
+      cluster total is the sum over sources).
+    """
+    live = set(live_replica_ids)
+    per_replica: Dict[str, float] = {}
+    queued = 0.0
+    for m in snapshots:
+        if m.get("name") == "serve_replica_ongoing_requests":
+            for s in m.get("samples", []):
+                tags = s.get("tags", {})
+                rid = tags.get("replica")
+                if tags.get("deployment") == deployment and rid in live:
+                    per_replica[rid] = s.get("value", 0.0)
+        elif m.get("name") == "serve_deployment_queued_queries":
+            for s in m.get("samples", []):
+                if s.get("tags", {}).get("deployment") == deployment:
+                    queued += s.get("value", 0.0)
+    return per_replica, queued
 
 
 async def _aget(ref, timeout: Optional[float] = None):

@@ -21,6 +21,7 @@ import contextlib
 import contextvars
 import json
 import os
+import random
 import secrets
 import threading
 import time
@@ -32,19 +33,49 @@ _buf: List[dict] = []
 _buf_lock = threading.Lock()
 _FLUSH_AT = 256
 
+# Production controls (reference: OpenTelemetry TraceIdRatioBased sampler
+# + span limits): the sampling decision is made ONCE at the trace root and
+# rides the W3C traceparent flags byte, so every process in the request
+# path agrees; a per-trace span cap bounds recording for pathological
+# fan-outs (a compiled-graph pipeline or a 1000-block dataset must not
+# write unbounded spans for one request).
+_sample_rate = 1.0
+_span_cap: Optional[int] = None
+_span_counts: dict = {}          # trace_id -> spans recorded here
+_SPAN_COUNT_MAX_TRACES = 4096    # bound the counter table itself
+
 _ctx: contextvars.ContextVar = contextvars.ContextVar(
-    "ray_tpu_trace_ctx", default=None)   # (trace_id, span_id)
+    "ray_tpu_trace_ctx", default=None)   # (trace_id, span_id, sampled)
 
 
 _flusher: Optional[threading.Thread] = None
 
 
-def enable_tracing(out_dir: Optional[str] = None) -> None:
+def enable_tracing(out_dir: Optional[str] = None, *,
+                   sample_rate: Optional[float] = None,
+                   max_spans_per_trace: Optional[int] = None) -> None:
     """Turn span recording on (reference: `ray.init(_tracing_startup_hook)`
     / RAY_TRACING_ENABLED). Workers inherit via the runtime-env
-    RAY_TPU_TRACE_DIR variable set by the driver."""
-    global _enabled, _dir, _flusher
+    RAY_TPU_TRACE_DIR / RAY_TPU_TRACE_SAMPLE / RAY_TPU_TRACE_SPAN_CAP
+    variables set by the driver.
+
+    `sample_rate` (0..1) is the head-sampling probability applied at each
+    trace ROOT: an unsampled trace still propagates context (so a sampled
+    child never orphans) but records nothing anywhere. Defaults to 1.0
+    (every request), which is for tests/debugging — production traffic
+    should run well below (e.g. 0.01)."""
+    global _enabled, _dir, _flusher, _sample_rate, _span_cap
     _enabled = True
+    if sample_rate is None:
+        sample_rate = float(os.environ.get("RAY_TPU_TRACE_SAMPLE", "1.0"))
+    _sample_rate = min(1.0, max(0.0, sample_rate))
+    os.environ["RAY_TPU_TRACE_SAMPLE"] = repr(_sample_rate)
+    if max_spans_per_trace is None:
+        cap = os.environ.get("RAY_TPU_TRACE_SPAN_CAP")
+        max_spans_per_trace = int(cap) if cap else None
+    _span_cap = max_spans_per_trace
+    if _span_cap is not None:
+        os.environ["RAY_TPU_TRACE_SPAN_CAP"] = str(_span_cap)
     if out_dir is None:
         out_dir = os.environ.get("RAY_TPU_TRACE_DIR") or os.path.join(
             os.environ.get("TMPDIR", "/tmp"), "ray_tpu_tracing")
@@ -86,11 +117,11 @@ def _maybe_autoenable() -> None:
 
 def current_traceparent() -> Optional[str]:
     """W3C traceparent for the ACTIVE span (None outside any span or
-    with tracing off)."""
+    with tracing off). The flags byte carries the sampling decision."""
     ctx = _ctx.get()
     if ctx is None:
         return None
-    return f"00-{ctx[0]}-{ctx[1]}-01"
+    return f"00-{ctx[0]}-{ctx[1]}-{'01' if ctx[2] else '00'}"
 
 
 def _parse_traceparent(tp: Optional[str]):
@@ -99,11 +130,26 @@ def _parse_traceparent(tp: Optional[str]):
     parts = tp.split("-")
     if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
         return None
-    return parts[1], parts[2]
+    sampled = parts[3] != "00"
+    return parts[1], parts[2], sampled
+
+
+def _under_span_cap(trace_id: str) -> bool:
+    if _span_cap is None:
+        return True
+    n = _span_counts.get(trace_id, 0)
+    if n >= _span_cap:
+        return False
+    if len(_span_counts) >= _SPAN_COUNT_MAX_TRACES:
+        _span_counts.clear()   # bounded memory beats exact caps
+    _span_counts[trace_id] = n + 1
+    return True
 
 
 def _record(span: dict) -> None:
     with _buf_lock:
+        if not _under_span_cap(span["trace_id"]):
+            return
         _buf.append(span)
         if len(_buf) >= _FLUSH_AT:
             _flush_locked()
@@ -135,10 +181,22 @@ def span(name: str, *, parent: Optional[str] = None,
         yield None
         return
     parent_ctx = _parse_traceparent(parent) or _ctx.get()
-    trace_id = (parent_ctx[0] if parent_ctx
-                else secrets.token_hex(16))
+    if parent_ctx:
+        trace_id, sampled = parent_ctx[0], parent_ctx[2]
+    else:
+        # Trace root: the head-sampling decision, inherited by every
+        # descendant span in every process via the traceparent flags.
+        trace_id = secrets.token_hex(16)
+        sampled = _sample_rate >= 1.0 or random.random() < _sample_rate
     span_id = secrets.token_hex(8)
-    token = _ctx.set((trace_id, span_id))
+    token = _ctx.set((trace_id, span_id, sampled))
+    if not sampled:
+        try:
+            yield {"trace_id": trace_id, "span_id": span_id,
+                   "sampled": False}
+        finally:
+            _ctx.reset(token)
+        return
     t0 = time.time()
     err: Optional[str] = None
     try:

@@ -98,6 +98,32 @@ def test_actor_restart(ray_cluster):
     assert val == 1, f"actor did not restart cleanly (val={val})"
 
 
+def test_actor_task_retry_through_restart(ray_cluster):
+    """max_task_retries: calls in flight when the worker dies are
+    transparently resubmitted to the restarted incarnation — no
+    ActorDiedError escapes (the round-5 chaos regression: the owner
+    failed in-flight tasks on ConnectionLost without consuming the
+    retry budget)."""
+    import signal
+
+    ray = ray_cluster
+
+    @ray.remote(max_restarts=4, max_task_retries=8)
+    class Adder:
+        def add(self, a, b):
+            return a + b
+
+    a = Adder.remote()
+    assert ray.get(a.add.remote(1, 1), timeout=60) == 2
+    pid = ray.get(a.__ray_call__.remote(lambda inst: os.getpid()),
+                  timeout=60)
+    refs = [a.add.remote(i, 1) for i in range(20)]
+    os.kill(pid, signal.SIGKILL)
+    assert ray.get(refs, timeout=180) == [i + 1 for i in range(20)]
+    # And the restarted actor keeps serving.
+    assert ray.get(a.add.remote(40, 2), timeout=60) == 42
+
+
 def test_unserializable_return_is_error_not_hang(ray_cluster):
     ray = ray_cluster
 

@@ -328,3 +328,99 @@ def test_serve_request_single_trace_spans(tmp_path):
         import os
 
         os.environ.pop("RAY_TPU_TRACE_DIR", None)
+
+
+def test_trace_sampling_and_span_caps(tmp_path):
+    """Head sampling decides at the trace root and rides the traceparent
+    flags (unsampled traces record nothing anywhere but still propagate
+    context); per-trace span caps bound recording for request fan-outs."""
+    import os
+
+    from ray_tpu.util import tracing
+
+    def reset():
+        tracing._enabled = False
+        tracing._sample_rate = 1.0
+        tracing._span_cap = None
+        tracing._span_counts.clear()
+        for k in ("RAY_TPU_TRACE_DIR", "RAY_TPU_TRACE_SAMPLE",
+                  "RAY_TPU_TRACE_SPAN_CAP"):
+            os.environ.pop(k, None)
+
+    reset()
+    try:
+        # sample_rate=0: nothing records, context still flows.
+        d0 = str(tmp_path / "s0")
+        tracing.enable_tracing(d0, sample_rate=0.0)
+        with tracing.span("root"):
+            tp = tracing.current_traceparent()
+            assert tp is not None and tp.endswith("-00"), tp
+            with tracing.span("child"):
+                pass
+        tracing.flush()
+        assert tracing.collect(d0) == []
+
+        # A propagated not-sampled parent suppresses child recording too
+        # (cross-process agreement).
+        with tracing.span("w", parent="00-" + "a" * 32 + "-" + "b" * 16
+                          + "-00"):
+            pass
+        tracing.flush()
+        assert tracing.collect(d0) == []
+        reset()
+
+        # sample_rate=1 + cap: at most N spans per trace are recorded.
+        d1 = str(tmp_path / "s1")
+        tracing.enable_tracing(d1, sample_rate=1.0, max_spans_per_trace=3)
+        with tracing.span("root"):
+            for i in range(10):
+                with tracing.span(f"n{i}"):
+                    pass
+        tracing.flush()
+        spans = tracing.collect(d1)
+        assert len(spans) == 3, [s["name"] for s in spans]
+    finally:
+        reset()
+
+
+def test_cgraph_one_span_per_execute(tmp_path):
+    """A compiled-graph execution emits ONE (sampled) span per execute,
+    not one per node — production traffic through a 3-actor pipeline
+    must not triple the span volume."""
+    import os
+
+    import ray_tpu
+    from ray_tpu.dag import InputNode
+    from ray_tpu.util import tracing
+
+    tracing._enabled = False
+    d = str(tmp_path / "cg")
+    tracing.enable_tracing(d, sample_rate=1.0)
+    ray_tpu.init(local_mode=True, ignore_reinit_error=True)
+    try:
+        @ray_tpu.remote
+        class S:
+            def f(self, x):
+                return x + 1
+
+        a, b, c = S.remote(), S.remote(), S.remote()
+        with InputNode() as inp:
+            dag = c.f.bind(b.f.bind(a.f.bind(inp)))
+        compiled = dag.experimental_compile()
+        try:
+            for i in range(4):
+                assert ray_tpu.get(compiled.execute(i)) == i + 3
+        finally:
+            compiled.teardown()
+        tracing.flush()
+        spans = tracing.collect(d)
+        execs = [s for s in spans if s["name"] == "cgraph.execute"]
+        assert len(execs) == 4, [s["name"] for s in spans]
+        assert not any(s["name"].startswith("cgraph:") for s in spans)
+    finally:
+        ray_tpu.shutdown()
+        tracing._enabled = False
+        tracing._sample_rate = 1.0
+        for k in ("RAY_TPU_TRACE_DIR", "RAY_TPU_TRACE_SAMPLE",
+                  "RAY_TPU_TRACE_SPAN_CAP"):
+            os.environ.pop(k, None)

@@ -319,3 +319,74 @@ def test_model_composition(serve_instance):
         assert json.loads(resp.read()) == 11
     serve.delete("Ingress")
     serve.delete("Doubler")
+
+
+def test_compiled_deployment_chain(serve_instance):
+    """A fixed two-deployment pipeline compiled onto pinned replicas
+    answers through channels (no router hop), matches the handle path,
+    and tears down cleanly."""
+    serve = serve_instance
+    import ray_tpu
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Biaser:
+        def __call__(self, x):
+            return x + 3
+
+    serve.run(Doubler.bind(), name="d", route_prefix="/double")
+    serve.run(Biaser.bind(), name="b", route_prefix="/bias")
+
+    compiled = serve.compile_deployment_chain(["Doubler", "Biaser"])
+    try:
+        assert ray_tpu.get(compiled.execute(5), timeout=60) == 13
+        # Matches the routed handle path.
+        d = serve.get_deployment_handle("Doubler")
+        b = serve.get_deployment_handle("Biaser")
+        assert b.remote(d.remote(5).result(timeout_s=60)) \
+            .result(timeout_s=60) == 13
+        # Pipelined: many requests through the persistent loops.
+        refs = [compiled.execute(i) for i in range(20)]
+        assert [ray_tpu.get(r, timeout=60) for r in refs] \
+            == [i * 2 + 3 for i in range(20)]
+    finally:
+        compiled.teardown()
+    # The routed path still works after teardown.
+    d = serve.get_deployment_handle("Doubler")
+    assert d.remote(4).result(timeout_s=60) == 8
+    serve.delete("Doubler")
+    serve.delete("Biaser")
+
+
+def test_autoscaler_consumes_gauges():
+    """The controller folds the data plane's own gauges
+    (serve_replica_ongoing_requests + serve_deployment_queued_queries)
+    into its scaling signal instead of polling replicas (unit test of
+    the fold; the end-to-end behavior is test_autoscaling_scales_up...)."""
+    from ray_tpu.serve._private.controller import (
+        _deployment_load_from_samples)
+
+    snaps = [
+        {"name": "serve_replica_ongoing_requests", "type": "gauge",
+         "samples": [
+             {"tags": {"deployment": "M", "replica": "M#1"}, "value": 3},
+             {"tags": {"deployment": "M", "replica": "M#dead"},
+              "value": 9},            # not in the live set: ignored
+             {"tags": {"deployment": "other", "replica": "o#1"},
+              "value": 7},            # another deployment: ignored
+         ]},
+        {"name": "serve_deployment_queued_queries", "type": "gauge",
+         "samples": [
+             {"tags": {"deployment": "M"}, "value": 4},
+             {"tags": {"deployment": "M"}, "value": 2},  # second router
+             {"tags": {"deployment": "other"}, "value": 5},
+         ]},
+    ]
+    per_replica, queued = _deployment_load_from_samples(
+        snaps, "M", ["M#1", "M#2"])
+    assert per_replica == {"M#1": 3}
+    assert queued == 6
