@@ -77,7 +77,11 @@ def main() -> None:
     # the compiled-graph micro-bench — a 3-actor chain via
     # experimental_compile().execute() vs the same chain through
     # dag.execute()'s per-task path (`cgraph_call_ms`,
-    # `dag_chain_call_ms`, `cgraph_vs_dag_speedup`).
+    # `dag_chain_call_ms`, `cgraph_vs_dag_speedup`) — and, via
+    # --attribute, the submit-path attribution breakdown (encode / lease
+    # / frame write / push rtt / worker decode+exec) so every BENCH_r*
+    # records where the task-plane time went, not just how much there
+    # was.
     notes = {}
     try:
         import os
@@ -85,7 +89,8 @@ def main() -> None:
         import sys
 
         out = subprocess.run(
-            [sys.executable, "-m", "ray_tpu.perf", "--scale", "0.5"],
+            [sys.executable, "-m", "ray_tpu.perf", "--scale", "0.5",
+             "--attribute"],
             capture_output=True, text=True, timeout=300,
             env=dict(os.environ, JAX_PLATFORMS="cpu"))
         notes = json.loads(out.stdout.strip().splitlines()[-1])

@@ -1,0 +1,115 @@
+"""Per-call attribution for the task-plane hot path.
+
+Reference equivalent: the per-RPC latency histograms the reference keeps
+in `stats/metric_defs.h` (e.g. `scheduler_task_time`) that make a task
+regression attributable to a stage instead of an archaeology project.
+
+Design constraints, in order:
+
+1. **Zero cost when off.** Every instrumentation site is guarded by the
+   module-level `enabled` bool — one global load per call site, no
+   function call, no clock read. The hot path (submit -> lease -> push
+   -> decode -> dispatch) pays nothing in normal operation.
+2. **Cheap when on.** `record()` is two dict ops on a plain dict; spans
+   accumulate (count, total_s, max_s) per label, never per-event lists,
+   so a 100k-task bench can't blow memory.
+3. **Cross-process.** The driver enables attribution via the
+   `RAY_TPU_ATTRIBUTION` env var, which spawned workers inherit; the
+   worker folds its own decode/execute timings into each task reply
+   (a few ints, only when enabled) so the driver-side snapshot covers
+   both sides of the wire without a separate scrape protocol.
+
+Labels in the submit-path breakdown (see `python -m ray_tpu.perf
+--attribute` and the PROFILE.md table):
+
+- ``submit.encode``     spec construction + template/wire encode
+- ``submit.lease``      time waiting for a leased worker (pool hit ~= 0)
+- ``submit.push_rtt``   push_task RPC round trip (includes execution)
+- ``rpc.frame_write``   transport write syscalls (batched writer)
+- ``wire.decode``       validated from_wire (whichever process decodes)
+- ``wire.decode_fast``  post-handshake fast-path decode
+- ``worker.decode``     worker-side task-spec decode (from replies)
+- ``worker.exec``       worker-side execute wall time (from replies)
+- ``get.local_shm``     node-local shm reads that bypassed the raylet
+- ``get.pull_rpc``      gets that did take the raylet pull_object RPC
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict
+
+ENV_FLAG = "RAY_TPU_ATTRIBUTION"
+
+# Module-level guard, read directly by hot-path call sites:
+#   if attribution.enabled: t0 = time.perf_counter(); ...
+enabled = bool(os.environ.get(ENV_FLAG))
+
+_lock = threading.Lock()
+_stats: Dict[str, list] = {}   # label -> [count, total_s, max_s]
+
+
+def enable() -> None:
+    """Turn attribution on for this process AND processes spawned after
+    this call (the env var rides into workers via their inherited
+    environment)."""
+    global enabled
+    enabled = True
+    os.environ[ENV_FLAG] = "1"
+
+
+def disable() -> None:
+    global enabled
+    enabled = False
+    os.environ.pop(ENV_FLAG, None)
+
+
+def reset() -> None:
+    with _lock:
+        _stats.clear()
+
+
+def record(label: str, dt: float) -> None:
+    """Fold one span of `dt` seconds into `label`'s accumulator."""
+    s = _stats.get(label)
+    if s is None:
+        with _lock:
+            s = _stats.setdefault(label, [0, 0.0, 0.0])
+    # Benign races on += under the GIL can undercount slightly; a
+    # profiler trades that for not taking a lock per span.
+    s[0] += 1
+    s[1] += dt
+    if dt > s[2]:
+        s[2] = dt
+
+
+def count(label: str, n: int = 1) -> None:
+    """Count an event with no duration (e.g. a bypass hit)."""
+    s = _stats.get(label)
+    if s is None:
+        with _lock:
+            s = _stats.setdefault(label, [0, 0.0, 0.0])
+    s[0] += n
+
+
+def snapshot() -> Dict[str, Dict[str, float]]:
+    """{label: {count, total_ms, mean_us, max_us}} for reporting."""
+    out = {}
+    with _lock:
+        items = [(k, list(v)) for k, v in _stats.items()]
+    for label, (n, total, mx) in sorted(items):
+        out[label] = {
+            "count": n,
+            "total_ms": round(total * 1e3, 3),
+            "mean_us": round(total / n * 1e6, 2) if n else 0.0,
+            "max_us": round(mx * 1e6, 2),
+        }
+    return out
+
+
+def fold(remote: Dict[str, float], prefix: str = "worker.") -> None:
+    """Fold a worker-reported {label: seconds-or-us} fragment into the
+    local table (labels arrive already in microseconds as ints)."""
+    for label, us in remote.items():
+        record(prefix + label, us / 1e6)

@@ -27,7 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
-from ray_tpu.core import serialization
+from ray_tpu.core import attribution, serialization
 from ray_tpu.core.config import ray_config
 from ray_tpu.core.function_manager import FunctionManager
 from ray_tpu.core.gcs.client import GcsClient
@@ -36,11 +36,16 @@ from ray_tpu.core.ids import (ActorID, JobID, NodeID, ObjectID, TaskID,
                               WorkerID, _Counter)
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.object_store import WorkerStoreClient, _WriteIntoShm
+from ray_tpu.core.runtime_env import env_hash
 from ray_tpu.core.wire import (ActorTaskSpec as WireActorTaskSpec,
                                LeaseRequest as WireLeaseRequest,
-                               TaskSpec as WireTaskSpec, from_wire, to_wire)
+                               SpecTemplate,
+                               TaskSpec as WireTaskSpec, from_wire,
+                               from_wire_fast, to_wire)
 from ray_tpu.core.rpc import (ConnectionLost, EventLoopThread, RpcClient,
                               RpcError, RpcServer, ServerConnection)
+from ray_tpu.util.tracing import (current_traceparent, span,
+                                  tracing_enabled)
 from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
                                 GetTimeoutError, ObjectLostError,
                                 RayActorError, RayTaskError,
@@ -125,15 +130,21 @@ class _LeasePool:
 
     @property
     def MAX_INFLIGHT(self) -> int:
-        from ray_tpu.core.config import ray_config
+        # Snapshot on first read: a config attribute read costs an
+        # os.environ lookup, and this sits on the per-submit path.
+        v = self._max_inflight
+        if v is None:
+            from ray_tpu.core.config import ray_config
 
-        return ray_config(
-        ).max_pending_lease_requests_per_scheduling_category
+            v = self._max_inflight = ray_config(
+            ).max_pending_lease_requests_per_scheduling_category
+        return v
 
     def __init__(self):
         self.idle: List[dict] = []
         self.inflight_leases = 0        # lease RPCs in flight to raylets
         self.waiters: List[Any] = []    # futures of queued acquires
+        self._max_inflight: Optional[int] = None
 
 
 class ClusterRuntime:
@@ -181,6 +192,26 @@ class ClusterRuntime:
 
         self._pending_releases: Any = _deque()
         self._release_drain_scheduled = False
+        # Submit coalescing (see submit_task): queued submissions drained
+        # by ONE loop wakeup per burst instead of one self-pipe write per
+        # task (a syscall that costs 20+ us on virtualized hosts).
+        self._pending_submits: Any = _deque()
+        self._submit_drain_scheduled = False
+        # Template-spec caches (wire.SpecTemplate): invariant wire dicts
+        # for repeated task/actor-method submissions, keyed by every
+        # invariant field so an options/runtime-env change misses.
+        self._spec_templates: Dict[tuple, Tuple[SpecTemplate, str]] = {}
+        self._actor_templates: Dict[tuple, SpecTemplate] = {}
+        # Node-local shm objects this process wrote (put path): get()
+        # reads them back without the raylet pull_object round trip.
+        self._local_shm: Dict[str, dict] = {}
+        # Syscall caches: getpid costs ~20 us on virtualized hosts and
+        # the task path reads it 3x per task; config attribute reads do
+        # an os.environ lookup each. Snapshot both per process.
+        self._pid = os.getpid()
+        cfg = ray_config()
+        self._pipeline_depth = cfg.worker_pipeline_depth
+        self._pipeline_svc_threshold = cfg.pipeline_service_threshold_s
         # Every granted task lease, until returned — the lease watchdog
         # sweeps this for orphans (see _lease_watchdog).
         self._live_leases: List[dict] = []
@@ -377,7 +408,7 @@ class ClusterRuntime:
         task_event_buffer().record(
             task_id, name, event, job_id=job_id or self.job_id.hex(),
             node_id=self.node_id.hex(), worker_id=self.address,
-            pid=os.getpid(), **extra)
+            pid=self._pid, **extra)
 
     async def _flush_task_events_loop(self) -> None:
         from ray_tpu.core.task_events import task_event_buffer
@@ -660,6 +691,11 @@ class ClusterRuntime:
         reference drops; deferred (object_store._deferred) while
         deserialized zero-copy views still alias the mapping."""
         name = self._shm_by_oid.pop(oid, None)
+        local = self._local_shm.pop(oid, None)
+        if name is None and local is not None:
+            # Locally-put object that was only ever read via the bypass:
+            # release the probe attachment too.
+            name = local["shm_name"]
         if name is not None:
             try:
                 self._shm.release(name)
@@ -738,6 +774,11 @@ class ClusterRuntime:
         # connection, and remote pulls poll until the seal lands
         # (handle_pull_object), so nothing needs the round trip.
         self._loop.run(self._raylet.notify("seal_object", oid=oid))
+        # Remember the segment so a local get() reads it back without a
+        # raylet round trip (pull_object exists for REMOTE resolution;
+        # a node-local read needs neither the RPC nor any pull-manager
+        # bookkeeping). Invalidation: try_attach fails after eviction.
+        self._local_shm[oid] = {"shm_name": shm_name, "size": size}
         if self.raylet_address not in entry.nodes:
             entry.nodes.append(self.raylet_address)
         entry.is_stored = True
@@ -802,6 +843,19 @@ class ClusterRuntime:
                     wrapped_fut = entry.fut
             if kind == "inline":
                 return ("inline", payload, oid)
+            # Node-local fast path: an object THIS process wrote to the
+            # local store is read straight from its shm segment — no
+            # pull_object RPC, no pull-manager admission (the budget is
+            # for genuinely remote transfers). try_attach doubles as the
+            # eviction check: an unlinked segment fails to attach and we
+            # fall through to the raylet, which restores/re-pulls.
+            info = self._local_shm.get(oid)
+            if info is not None:
+                if self._shm.try_attach(info["shm_name"]):
+                    if attribution.enabled:
+                        attribution.count("get.local_shm")
+                    return ("shm", info, oid)
+                self._local_shm.pop(oid, None)   # evicted: re-resolve
             # stored on some node; pull through the local raylet
             owner_addr = self.address
         else:
@@ -810,6 +864,8 @@ class ClusterRuntime:
                           else owner)
         remaining = (None if deadline is None
                      else max(0.0, deadline - time.monotonic()))
+        if attribution.enabled:
+            attribution.count("get.pull_rpc")
         try:
             res = await asyncio.wait_for(self._raylet.call(
                 "pull_object", oid=oid, owner_address=owner_addr,
@@ -834,7 +890,25 @@ class ClusterRuntime:
         return self._read_local_shm(payload, oid)
 
     def _fetch(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
-        """Blocking fetch of one object's value."""
+        """Blocking fetch of one object's value.
+
+        Resolved-owned fast path: when the result already landed (inline
+        future done, or a node-local segment we wrote), the value is read
+        on THIS thread — no event-loop round trip, which costs a
+        self-pipe write plus a futex wait per call and dominated the
+        sequential-get p50 on syscall-expensive hosts."""
+        oid = ref.hex()
+        with self._owned_lock:
+            entry = self._owned.get(oid)
+        if entry is not None and entry.fut.done():
+            kind, payload = entry.fut.result()
+            if kind == "inline":
+                return self._deserialize_payload(payload)
+            info = self._local_shm.get(oid)
+            if info is not None and self._shm.try_attach(info["shm_name"]):
+                if attribution.enabled:
+                    attribution.count("get.local_shm")
+                return self._read_local_shm(info, oid)
         return self._materialize(
             self._loop.run(self._resolve_async(ref, timeout), timeout=None))
 
@@ -984,23 +1058,22 @@ class ClusterRuntime:
     # task submission (reference: direct_task_transport.cc)
     # ==================================================================
     def submit_task(self, remote_function, opts, args, kwargs):
-        from ray_tpu.core.options import resource_demand
-
+        _t0 = time.perf_counter() if attribution.enabled else 0.0
         task_id = TaskID.for_task(self.job_id)
         fn_key = self._fn.export(remote_function._function)
         streaming = opts.num_returns in ("streaming", "dynamic")
         num_returns = 1 if streaming else opts.num_returns
         args_blob, pinned = self._serialize_args(args, kwargs)
-        env = _prepared_env(self, opts)
-        pg = _pg_id_of(getattr(opts, "placement_group", None))
-        # Typed wire message (core/wire.py TaskSpec): field presence and
-        # types are enforced at construction AND on the receiver's decode.
-        spec = WireTaskSpec(
-            task_id=task_id.hex(),
-            job_id=self.job_id.hex(),
-            fn_key=fn_key,
-            name=remote_function._function_name,
-            args=args_blob,
+        # Propagate the caller's span so the worker-side execution span
+        # parents across the process boundary — INCLUDING unsampled
+        # contexts: the head decision must ride the flags byte, or the
+        # worker would re-roll sampling per task and record orphan
+        # roots. Unsampled propagation is near-free since span() takes
+        # the PRNG fast path for it (util/tracing.py).
+        trace_ctx = current_traceparent() if tracing_enabled() else None
+        spec, sched_key = self._encode_task_spec(
+            remote_function, opts, fn_key, num_returns, streaming,
+            task_id=task_id.hex(), args=args_blob,
             # TOP-LEVEL arg refs only, for pre-lease dependency
             # resolution (reference: dependency_resolver.h — deps resolve
             # BEFORE a worker is leased, so a blocked dependency never
@@ -1010,26 +1083,9 @@ class ClusterRuntime:
             arg_oids=[a.hex() for a in
                       list(args) + list(kwargs.values())
                       if isinstance(a, ObjectRef)],
-            num_returns=num_returns,
-            streaming=streaming,
-            owner=self.address,
-            resources=resource_demand(opts),
-            max_retries=opts.max_retries,
-            runtime_env=env or None,
-            pg=(None if pg is None else {
-                "pg_id": pg,
-                "bundle_index": getattr(
-                    opts, "placement_group_bundle_index", -1),
-            }),
-        )
-        from ray_tpu.util.tracing import (current_traceparent,
-                                          tracing_enabled)
-
-        if tracing_enabled():
-            # Propagate the caller's span so the worker-side execution
-            # span parents across the process boundary
-            # (reference: tracing_helper._inject_tracing_into_function).
-            spec.trace_ctx = current_traceparent()
+            trace_ctx=trace_ctx)
+        if attribution.enabled:
+            attribution.record("submit.encode", time.perf_counter() - _t0)
         refs = self._make_return_refs(task_id, num_returns)
         gen = None
         if streaming:
@@ -1048,13 +1104,129 @@ class ClusterRuntime:
                    "live": len(refs), "inflight": False}
             for r in refs:
                 self._lineage[r.hex()] = rec
-        self._loop.spawn(self._submit_async(
-            spec, refs, pinned if not retain else None))
+        self._enqueue_submit(
+            ("task", spec, refs, pinned if not retain else None,
+             sched_key))
         if streaming:
             return gen
         if opts.num_returns == 0:
             return None
         return refs[0] if opts.num_returns == 1 else refs
+
+    def _encode_task_spec(self, remote_function, opts, fn_key: str,
+                          num_returns: int, streaming: bool, *,
+                          task_id: str, args: bytes, arg_oids: list,
+                          trace_ctx: Optional[str]
+                          ) -> Tuple[dict, str]:
+        """Wire dict + lease scheduling key for one task submission.
+
+        Template-spec encoding (reference: the TaskSpec invariants
+        `direct_task_transport` re-ships unchanged thousands of times):
+        the first submission of a (function, options, runtime-env) shape
+        builds a fully-validated WireTaskSpec and caches its wire dict;
+        repeats copy the template and overwrite only task_id/args/
+        arg_oids/trace_ctx. The cache key carries every invariant field,
+        so ANY options or runtime-env change misses and re-validates —
+        that is the invalidation contract tests/test_unit_spec_template
+        pins down."""
+        from ray_tpu.core.options import resource_demand
+
+        raw_env = getattr(opts, "runtime_env", None)
+        # working_dir/pip envs re-prepare per call (their content can
+        # change under the same raw spec — a template would freeze a
+        # stale upload key); env_vars-only envs are value-stable and
+        # cacheable via their hash.
+        cacheable = not raw_env or set(raw_env) <= {"env_vars"}
+        resources = resource_demand(opts)
+        tkey = (fn_key, num_returns, streaming, opts.max_retries,
+                env_hash(raw_env) if raw_env else "",
+                _pg_id_of(getattr(opts, "placement_group", None)),
+                getattr(opts, "placement_group_bundle_index", -1),
+                tuple(sorted(resources.items())))
+        hit = self._spec_templates.get(tkey) if cacheable else None
+        if hit is None:
+            env = _prepared_env(self, opts)
+            pg = tkey[5]
+            # Typed wire message (core/wire.py TaskSpec): field presence
+            # and types are enforced at construction AND on the
+            # receiver's validated decode.
+            proto = WireTaskSpec(
+                task_id=task_id,
+                job_id=self.job_id.hex(),
+                fn_key=fn_key,
+                name=remote_function._function_name,
+                args=args,
+                arg_oids=arg_oids,
+                num_returns=num_returns,
+                streaming=streaming,
+                owner=self.address,
+                resources=resources,
+                max_retries=opts.max_retries,
+                runtime_env=env or None,
+                pg=(None if pg is None else {
+                    "pg_id": pg, "bundle_index": tkey[6]}),
+                trace_ctx=trace_ctx,
+            )
+            sched_key = self._sched_key_of(proto)
+            hit = (SpecTemplate(proto), sched_key)
+            if cacheable:
+                if len(self._spec_templates) >= 512:
+                    self._spec_templates.clear()  # bounded, simple
+                self._spec_templates[tkey] = hit
+        tmpl, sched_key = hit
+        return (tmpl.encode(task_id=task_id, args=args,
+                            arg_oids=arg_oids, trace_ctx=trace_ctx),
+                sched_key)
+
+    @staticmethod
+    def _sched_key_of(spec) -> str:
+        """Lease scheduling key (worker-compatibility class) of a task
+        spec. Distinct runtime envs never share a leased worker."""
+        pg = spec.get("pg")
+        key = (f"{spec['fn_key']}:{sorted(spec['resources'].items())}"
+               f":{pg['pg_id']}:{pg['bundle_index']}" if pg else
+               f"{spec['fn_key']}:{sorted(spec['resources'].items())}")
+        return key + f":{env_hash(spec.get('runtime_env'))}"
+
+    def _enqueue_submit(self, item: tuple) -> None:
+        """Queue a submission for the RPC loop, coalescing loop wakeups.
+
+        `loop.spawn` per task means one `call_soon_threadsafe` — and one
+        self-pipe write syscall — per submission; at 20+ us/syscall on
+        virtualized hosts that alone capped the submit rate (measured
+        round 5). Appends are GIL-atomic (same discipline as
+        deferred_release); one scheduled drain spawns every queued
+        submission in FIFO order, so a burst pays one wakeup."""
+        if self._shutdown:
+            # Unlike dropped releases, a dropped SUBMISSION has
+            # observable results — the caller already holds ObjectRefs
+            # and a later get() would hang forever. Fail loudly at the
+            # submit site. (A stopped-but-not-closed loop accepts the
+            # call_soon below and simply never runs it — same silent
+            # outcome loop.spawn had — so the flag check, not the
+            # except, is what actually covers the shutdown race.)
+            raise RuntimeError("runtime is shut down; cannot submit")
+        self._pending_submits.append(item)
+        if not self._submit_drain_scheduled:
+            self._submit_drain_scheduled = True
+            try:
+                self._loop.call_soon(self._drain_submits)
+            except Exception:
+                self._submit_drain_scheduled = False
+                raise  # loop closed: surface at the submit call site
+
+    def _drain_submits(self) -> None:
+        self._submit_drain_scheduled = False
+        while self._pending_submits:
+            item = self._pending_submits.popleft()
+            if item[0] == "task":
+                _, spec, refs, pinned, sched_key = item
+                asyncio.ensure_future(self._submit_async(
+                    spec, refs, pinned, sched_key=sched_key))
+            else:
+                _, spec, refs, pinned = item
+                asyncio.ensure_future(
+                    self._submit_actor_async(spec, refs, pinned))
 
     def _make_return_refs(self, task_id: TaskID,
                           num_returns: int) -> List[ObjectRef]:
@@ -1068,10 +1240,20 @@ class ClusterRuntime:
             refs.append(ObjectRef(oid, owner=self.address, runtime=self))
         return refs
 
+    _empty_args_blob: Optional[bytes] = None
+
     def _serialize_args(self, args, kwargs) -> Tuple[bytes, List[ObjectID]]:
         """Serialize task arguments, pinning every contained ObjectRef so the
         owner does not free it while the task spec is in flight (reference:
         reference_count.h submitted-task counts)."""
+        if not args and not kwargs:
+            # Zero-arg calls share one precomputed blob: nothing to pin,
+            # and the ~25 us cloudpickle pass is identical every time.
+            blob = ClusterRuntime._empty_args_blob
+            if blob is None:
+                blob = ClusterRuntime._empty_args_blob = (
+                    serialization.serialize(((), {})).to_bytes())
+            return blob, []
         pinned: List[ObjectID] = []
         blob = serialization.serialize(
             (args, kwargs),
@@ -1105,7 +1287,8 @@ class ClusterRuntime:
                 await asyncio.sleep(0.02)
 
     async def _submit_async(self, spec: dict, refs: List[ObjectRef],
-                            pinned: Optional[List[ObjectID]] = None) -> None:
+                            pinned: Optional[List[ObjectID]] = None,
+                            sched_key: Optional[str] = None) -> None:
         retries = spec.get("max_retries", 0)
         attempt = 0
         try:
@@ -1115,7 +1298,7 @@ class ClusterRuntime:
                     # a node died, taking this task's upstream objects
                     # with it.
                     await self._resolve_dependencies(spec)
-                    await self._run_on_leased_worker(spec)
+                    await self._run_on_leased_worker(spec, sched_key)
                     return
                 except (ConnectionLost, RpcError, TimeoutError,
                         asyncio.TimeoutError, OSError) as e:
@@ -1196,16 +1379,18 @@ class ClusterRuntime:
             from ray_tpu.exceptions import WorkerCrashedError as WCE
             gen._finish(WCE(f"task {spec['name']}: {message}"))
 
-    async def _run_on_leased_worker(self, spec: dict) -> None:
+    async def _run_on_leased_worker(self, spec: dict,
+                                    sched_key: Optional[str] = None
+                                    ) -> None:
         pg = spec.get("pg")
-        from ray_tpu.core.runtime_env import env_hash
-
-        key = (f"{spec['fn_key']}:{sorted(spec['resources'].items())}"
-               f":{pg['pg_id']}:{pg['bundle_index']}" if pg else
-               f"{spec['fn_key']}:{sorted(spec['resources'].items())}")
-        # Distinct runtime envs never share a leased worker.
-        key += f":{env_hash(spec.get('runtime_env'))}"
+        # The submit path hands the template-cached scheduling key down;
+        # resubmits (lineage re-execution) recompute it.
+        key = sched_key if sched_key is not None else self._sched_key_of(
+            spec)
+        _t0 = time.perf_counter() if attribution.enabled else 0.0
         worker = await self._acquire_worker(key, spec["resources"], pg=pg)
+        if attribution.enabled:
+            attribution.record("submit.lease", time.perf_counter() - _t0)
         if spec["task_id"] in self._cancel_requested:
             # Cancelled while queued for a lease: never push.
             self._offer_worker(key, worker)
@@ -1260,15 +1445,24 @@ class ClusterRuntime:
         worker["pipeline"] -= 1
         # Per-worker service-time EMA (push->reply, which bounds task
         # duration): drives the deep-pipelining gate in _offer_worker.
-        span = time.monotonic() - push_t0
+        rtt = time.monotonic() - push_t0
         prev = worker.get("svc_ema")
-        worker["svc_ema"] = (span if prev is None
-                             else 0.7 * prev + 0.3 * span)
+        worker["svc_ema"] = (rtt if prev is None
+                             else 0.7 * prev + 0.3 * rtt)
+        if attribution.enabled:
+            attribution.record("submit.push_rtt", rtt)
         self._record_task_reply(spec, reply)
         self._offer_worker(key, worker)
 
     def _record_task_reply(self, spec: dict, reply: dict) -> None:
         task_id = spec["task_id"]
+        if attribution.enabled:
+            attr = reply.get("attr")
+            if attr:
+                # Worker-side decode/execute timings ride the reply (a
+                # couple of ints, only in attribution mode) so the
+                # driver's snapshot covers both sides of the wire.
+                attribution.fold(attr)
         if logger.isEnabledFor(logging.DEBUG):
             logger.debug("task reply %s (%s): %s", spec.get("name"),
                          task_id[:12],
@@ -1366,14 +1560,13 @@ class ClusterRuntime:
         if worker.get("dead") or worker.get("avail"):
             return
         pipeline = worker.get("pipeline", 0)
-        if pipeline >= ray_config().worker_pipeline_depth:
+        if pipeline >= self._pipeline_depth:
             return
         if pipeline > 0:
             ema = worker.get("svc_ema")
             # Deep pipelining (offering a still-executing worker) only
             # pays off for tasks shorter than a lease round trip.
-            if ema is None or ema > ray_config(
-                    ).pipeline_service_threshold_s:
+            if ema is None or ema > self._pipeline_svc_threshold:
                 return  # don't queue behind an unknown/slow task
         pool = self._lease_pools.setdefault(key, _LeasePool())
         self._hand_worker(pool, worker)
@@ -1741,6 +1934,7 @@ class ClusterRuntime:
         })
 
     def submit_actor_task(self, handle, method_name, opts, args, kwargs):
+        _t0 = time.perf_counter() if attribution.enabled else 0.0
         aid = handle._ray_actor_id.hex()
         task_id = TaskID.for_actor_task(handle._ray_actor_id)
         streaming = opts.num_returns in ("streaming", "dynamic")
@@ -1749,25 +1943,32 @@ class ClusterRuntime:
         with self._actor_seq_lock:
             seq = self._actor_call_seq.get(aid, 0)
             self._actor_call_seq[aid] = seq + 1
-        spec = WireActorTaskSpec(
-            task_id=task_id.hex(),
-            job_id=self.job_id.hex(),
-            actor_id=aid,
-            method=method_name,
-            name=f"{handle._class_name}.{method_name}",
-            args=args_blob,
-            num_returns=num_returns,
-            streaming=streaming,
-            owner=self.address,
-            seq=seq,
-            concurrency_group=(handle._method_meta or {}).get(
-                method_name, {}).get("concurrency_group"),
-        )
-        from ray_tpu.util.tracing import (current_traceparent,
-                                          tracing_enabled)
-
-        if tracing_enabled():
-            spec.trace_ctx = current_traceparent()
+        trace_ctx = current_traceparent() if tracing_enabled() else None
+        tkey = (aid, method_name, num_returns, streaming)
+        tmpl = self._actor_templates.get(tkey)
+        if tmpl is None:
+            proto = WireActorTaskSpec(
+                task_id=task_id.hex(),
+                job_id=self.job_id.hex(),
+                actor_id=aid,
+                method=method_name,
+                name=f"{handle._class_name}.{method_name}",
+                args=args_blob,
+                num_returns=num_returns,
+                streaming=streaming,
+                owner=self.address,
+                seq=seq,
+                concurrency_group=(handle._method_meta or {}).get(
+                    method_name, {}).get("concurrency_group"),
+                trace_ctx=trace_ctx,
+            )
+            if len(self._actor_templates) >= 1024:
+                self._actor_templates.clear()
+            tmpl = self._actor_templates[tkey] = SpecTemplate(proto)
+        spec = tmpl.encode(task_id=task_id.hex(), args=args_blob,
+                           seq=seq, trace_ctx=trace_ctx)
+        if attribution.enabled:
+            attribution.record("submit.encode", time.perf_counter() - _t0)
         refs = self._make_return_refs(task_id, num_returns)
         self._record_task_event(task_id.hex(), spec["name"], "SUBMITTED",
                                 actor_id=aid)
@@ -1775,7 +1976,7 @@ class ClusterRuntime:
         if streaming:
             gen = ObjectRefGenerator()
             self._generators[task_id.hex()] = gen
-        self._loop.spawn(self._submit_actor_async(spec, refs, pinned))
+        self._enqueue_submit(("actor", spec, refs, pinned))
         if streaming:
             return gen
         if opts.num_returns == 0:
@@ -2576,8 +2777,6 @@ class ClusterRuntime:
                 apply_runtime_env(self, spec["runtime_env"])
             fn = self._fn.fetch(spec["fn_key"])
             args, kwargs, arg_refs = self._resolve_task_args(spec["args"])
-            from ray_tpu.util.tracing import span, tracing_enabled
-
             if tracing_enabled() or spec.get("trace_ctx"):
                 # Execution span parents to the CALLER's span via the
                 # propagated traceparent (reference: tracing_helper's
@@ -2654,13 +2853,27 @@ class ClusterRuntime:
             out.append(self._package_result(oid, wrapped, is_error=True))
         return out
 
+    def _decode_spec(self, conn: ServerConnection, spec: dict,
+                     expect: str):
+        """Task-spec decode boundary. Post-handshake connections (the
+        peer's schema digest verified ours — conn.metadata['wire_fast'])
+        take the no-validate fast path; anything short of a perfect
+        envelope falls back inside from_wire_fast to the validated
+        decode, whose typed WireDecodeError names the offending field
+        instead of a KeyError inside the executor."""
+        if conn.metadata.get("wire_fast"):
+            return from_wire_fast(spec, expect)
+        return from_wire(spec, expect=expect)
+
     async def handle_push_task(self, conn: ServerConnection, *,
                                spec: dict) -> dict:
+        attr_on = attribution.enabled
+        _t0 = time.perf_counter() if attr_on else 0.0
         if isinstance(spec, dict) and "_t" in spec:
-            # Typed decode boundary: a malformed spec dies HERE with a
-            # WireDecodeError naming the field, not as a KeyError inside
-            # the executor.
-            spec = from_wire(spec, expect="TaskSpec")
+            spec = self._decode_spec(conn, spec, "TaskSpec")
+            if attr_on:
+                attribution.record("wire.decode_task",
+                                   time.perf_counter() - _t0)
         # Refuse work the moment our raylet is gone (don't wait to fail
         # on the result store): the pusher holds a stale lease on a dead
         # node; exiting here converts it to a clean worker-death retry
@@ -2669,8 +2882,14 @@ class ClusterRuntime:
         if spec.get("streaming"):
             return await self._execute_streaming(spec, actor=False)
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(
+        _t1 = time.perf_counter() if attr_on else 0.0
+        reply = await loop.run_in_executor(
             self._exec_pool, self._execute_task, spec)
+        if attr_on:
+            reply["attr"] = {
+                "decode": int((_t1 - _t0) * 1e6),
+                "exec": int((time.perf_counter() - _t1) * 1e6)}
+        return reply
 
     async def _execute_streaming(self, spec: dict, actor: bool) -> dict:
 
@@ -2823,8 +3042,6 @@ class ClusterRuntime:
                 raise TaskCancelledError(task_id)
             self._ensure_job_env(spec.get("job_id"))
             args, kwargs, arg_refs = self._resolve_task_args(spec["args"])
-            from ray_tpu.util.tracing import span, tracing_enabled
-
             traced = tracing_enabled() or spec.get("trace_ctx")
             ctx = (span(f"actor.run {name}",
                         parent=spec.get("trace_ctx"),
@@ -2875,8 +3092,14 @@ class ClusterRuntime:
 
     async def handle_push_actor_task(self, conn: ServerConnection, *,
                                      spec: dict) -> dict:
+        attr_on = attribution.enabled
+        _t0 = time.perf_counter() if attr_on else 0.0
         if isinstance(spec, dict) and "_t" in spec:
-            spec = from_wire(spec, expect="ActorTaskSpec")
+            spec = self._decode_spec(conn, spec, "ActorTaskSpec")
+        # Decode measured BEFORE the per-caller ordering gate: a task
+        # waiting its turn behind a slow predecessor is actor
+        # contention, and must not be booked as wire-decode cost.
+        decode_us = int((time.perf_counter() - _t0) * 1e6) if attr_on else 0
         if self._actor_instance is None:
             raise RpcError("no actor instance on this worker")
         if spec.get("streaming"):
@@ -2887,11 +3110,18 @@ class ClusterRuntime:
         await self._await_actor_turn(spec)
         executor = (getattr(self, "_actor_group_executors", {}) or {}).get(
             spec.get("concurrency_group"))
+        if attr_on:
+            _t1 = time.perf_counter()
         fut = loop.run_in_executor(
             executor or self._actor_executor or self._exec_pool,
             self._execute_actor_method, spec)
         self._advance_actor_turn(spec)
-        return await fut
+        reply = await fut
+        if attr_on:
+            reply["attr"] = {
+                "decode": decode_us,
+                "exec": int((time.perf_counter() - _t1) * 1e6)}
+        return reply
 
     # Explicit per-caller sequencing (reference:
     # sequential_actor_submit_queue.h): the caller stamps each actor task

@@ -442,6 +442,23 @@ class WorkerStoreClient:
             self._attached[shm_name] = shm
         return shm.buf[:size]
 
+    def try_attach(self, shm_name: str) -> bool:
+        """Attach `shm_name` if it still exists; False when the store
+        unlinked it (evicted/spilled). Used by the node-local read
+        bypass: attaching is the liveness check — the store never reuses
+        a segment name for another object and an existing mapping stays
+        valid after eviction (store.cc frozen-mapping guarantee), so
+        success here means a later `read` returns the right bytes."""
+        if shm_name in self._attached:
+            return True
+        try:
+            shm = shared_memory.SharedMemory(name=shm_name)
+        except (FileNotFoundError, OSError, ValueError):
+            return False
+        _untrack(shm)
+        self._attached[shm_name] = shm
+        return True
+
     # Mappings whose buffers were still referenced by deserialized
     # zero-copy arrays at release time: parked here and retried on later
     # releases, so a streaming consumer's mappings unmap one step behind

@@ -100,8 +100,13 @@ class _PullManager:
         self.in_use = 0
         self._waiters: list = []   # heap of (size, seq, Event)
         self._seq = 0
+        # local_reads counts node-local resolutions that bypassed
+        # admission entirely: the byte budget exists to pace inbound
+        # REMOTE transfers, and a local shm read must never queue behind
+        # them (nor charge the budget) — pinned by
+        # tests/test_unit_pull_manager.py.
         self.stats = {"admitted": 0, "queued": 0, "peak_bytes": 0,
-                      "active": 0}
+                      "active": 0, "local_reads": 0}
 
     async def admit(self, size: int) -> int:
         """Blocks until `size` bytes of transfer budget are granted.
@@ -1292,6 +1297,10 @@ class Raylet:
         while deadline is None or time.monotonic() < deadline:
             info = await self._store_io(self.store.info, oid)
             if info is not None:
+                # Local hit: never touches pull admission — the budget
+                # paces inbound remote transfers only (_pull_from_holder
+                # charges it; this path must not).
+                self._pulls.stats["local_reads"] += 1
                 return {"shm_name": info[0], "size": info[1]}
             if owner_address:
                 try:

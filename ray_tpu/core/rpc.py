@@ -22,6 +22,8 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 import msgpack
 
+from ray_tpu.core import attribution
+
 logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<I")
@@ -105,6 +107,19 @@ class _BatchedWriter:
         self._write(data)
 
     def _write(self, data: bytes) -> None:
+        if attribution.enabled:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            try:
+                self._write_inner(data)
+            finally:
+                attribution.record("rpc.frame_write",
+                                   _time.perf_counter() - t0)
+            return
+        self._write_inner(data)
+
+    def _write_inner(self, data: bytes) -> None:
         try:
             if (self._writer.transport is not None
                     and self._writer.transport.is_closing()):
@@ -218,9 +233,24 @@ class ServerConnection:
         if method == "__schema__":
             # Built-in schema handshake (core/wire.py): reply with our
             # digest; the CLIENT decides compatibility so old servers
-            # never have to know new messages.
-            from ray_tpu.core.wire import schema_digest
+            # never have to know new messages. A client that also SENDS
+            # its digest lets this side verify symmetry and unlock the
+            # fast-path decode (wire.from_wire_fast) for the connection:
+            # both encoders proven identical means per-field validation
+            # on every message buys nothing.
+            from ray_tpu.core.wire import (SchemaMismatchError,
+                                           check_digest, schema_digest)
 
+            peer = (msg.get("a") or {}).get("digest")
+            if peer:
+                try:
+                    check_digest(peer)
+                    self.metadata["wire_fast"] = True
+                except SchemaMismatchError:
+                    # The client will see the same mismatch from our
+                    # digest and fail its connect; until then every
+                    # decode on this conn stays validated.
+                    self.metadata["wire_fast"] = False
             await self._reply(req_id, ok=True, result=schema_digest())
             return
         handler = getattr(self._handlers, f"handle_{method}", None)
@@ -307,11 +337,16 @@ class RpcClient:
                     # with a typed error instead of corrupting a protocol
                     # exchange later (core/wire.py evolution rules).
                     from ray_tpu.core.wire import (SchemaMismatchError,
-                                                   check_digest)
+                                                   check_digest,
+                                                   schema_digest)
 
                     try:
+                        # Send our digest too: a server that verifies it
+                        # unlocks the post-handshake fast-path decode
+                        # for this connection (see ServerConnection).
                         digest = await self.call(
-                            "__schema__", timeout=max(5.0, timeout))
+                            "__schema__", digest=schema_digest(),
+                            timeout=max(5.0, timeout))
                     except ConnectionLost:
                         raise          # peer died mid-handshake
                     except (asyncio.TimeoutError, TimeoutError):

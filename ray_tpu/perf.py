@@ -3,8 +3,18 @@
 Reference equivalent: `python/ray/_private/ray_perf.py` — the numbers the
 reference budgets at 50-300 µs/task (SURVEY §3.2). Run directly:
 
-    python -m ray_tpu.perf            # cluster mode (multi-process)
-    python -m ray_tpu.perf --local    # local mode (in-process)
+    python -m ray_tpu.perf              # cluster mode (multi-process)
+    python -m ray_tpu.perf --local     # local mode (in-process)
+    python -m ray_tpu.perf --attribute # + submit-path breakdown
+
+`--attribute` turns on the per-call attribution profiler
+(core/attribution.py) for the driver AND every worker it spawns, then
+folds the spans into the output under "attribution": where each
+submitted task's time went (encode / lease wait / frame write / push
+round trip / worker decode / worker execute), plus a wire-decode
+microbench comparing the validated and post-handshake fast decoders.
+That breakdown is what makes the NEXT task-plane regression a lookup
+instead of an archaeology project (PROFILE.md has the round-6 table).
 
 Prints one JSON object; also importable (`run_microbench`) so bench.py
 and tests can embed the numbers.
@@ -26,6 +36,34 @@ def _p50(samples: List[float]) -> float:
     return s[len(s) // 2]
 
 
+def _p95(samples: List[float]) -> float:
+    s = sorted(samples) or [float("nan")]
+    return s[min(len(s) - 1, int(len(s) * 0.95))]
+
+
+def wire_decode_bench(n: int = 3000) -> Dict[str, float]:
+    """Validated vs fast-path decode of a representative TaskSpec, in
+    microseconds per message (the worker pays exactly one of these per
+    pushed task, fast after the schema handshake)."""
+    import msgpack
+
+    from ray_tpu.core.wire import TaskSpec, from_wire, from_wire_fast, to_wire
+
+    payload = msgpack.unpackb(msgpack.packb(to_wire(TaskSpec(
+        task_id="ab" * 16, job_id="cd" * 8, name="bench", fn_key="k" * 40,
+        args=b"x" * 200, resources={"CPU": 1.0}, owner="127.0.0.1:1")),
+        use_bin_type=True), raw=False)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        from_wire(payload)
+    t1 = time.perf_counter()
+    for _ in range(n):
+        from_wire_fast(payload)
+    t2 = time.perf_counter()
+    return {"validated_us": round((t1 - t0) / n * 1e6, 2),
+            "fast_us": round((t2 - t1) / n * 1e6, 2)}
+
+
 class _Counter:
     def __init__(self):
         self.n = 0
@@ -45,7 +83,8 @@ class _ChainStage:
 
 
 def run_microbench(local_mode: bool = False,
-                   scale: float = 1.0) -> Dict[str, Any]:
+                   scale: float = 1.0,
+                   attribute: bool = False) -> Dict[str, Any]:
     """Returns {metric: value} — throughputs in ops/s, latencies in ms."""
     import numpy as np
 
@@ -53,6 +92,12 @@ def run_microbench(local_mode: bool = False,
 
     import os
 
+    if attribute:
+        from ray_tpu.core import attribution
+
+        # Before init so spawned workers inherit the env flag.
+        attribution.enable()
+        attribution.reset()
     # More workers than cores just adds scheduler contention on small
     # hosts (every process shares the core with the driver + raylet).
     ncpu = min(4, max(2, os.cpu_count() or 1))
@@ -100,11 +145,13 @@ def run_microbench(local_mode: bool = False,
     dt = time.perf_counter() - t0
     out["actor_calls_per_s"] = round(n / dt, 1)
 
-    # 4. Object plane: 10 MB put + get (zero-copy read path); median of
-    # 5 — single samples on a shared host swing 3x on scheduler noise.
+    # 4. Object plane: 10 MB put + get (zero-copy read path); p50 AND
+    # p95 of 8 samples — the round-5 verdict found a 12x spread hiding
+    # behind single samples, so the variance itself is now a reported
+    # number (BENCH notes carry both).
     arr = np.zeros(10 * 1024 * 1024 // 4, np.float32)
     puts, gets = [], []
-    for i in range(5):
+    for i in range(8):
         t0 = time.perf_counter()
         ref = ray_tpu.put(arr)
         puts.append(time.perf_counter() - t0)
@@ -115,6 +162,8 @@ def run_microbench(local_mode: bool = False,
         time.sleep(0.1)  # segment-pool refill runs off the hot path
     out["put_10mb_ms"] = round(_p50(puts) * 1e3, 2)
     out["get_10mb_ms"] = round(_p50(gets) * 1e3, 2)
+    out["put_10mb_p95_ms"] = round(_p95(puts) * 1e3, 2)
+    out["get_10mb_p95_ms"] = round(_p95(gets) * 1e3, 2)
 
     # 5. Compiled graphs vs lazy DAG: the same 3-actor chain through
     # dag.execute (3 actor tasks/call) and experimental_compile
@@ -153,7 +202,30 @@ def run_microbench(local_mode: bool = False,
         ray_tpu.kill(s)
 
     ray_tpu.kill(counter)
+    if attribute:
+        from ray_tpu.core import attribution
+
+        out["attribution"] = attribution.snapshot()
+        out["attribution"]["wire_decode_bench"] = wire_decode_bench()
     return out
+
+
+def format_attribution(attr: Dict[str, Any]) -> str:
+    """Human table for `python -m ray_tpu.perf --attribute`."""
+    lines = [f"{'stage':28s} {'count':>8s} {'mean_us':>10s} "
+             f"{'total_ms':>10s} {'max_us':>10s}"]
+    for label, s in attr.items():
+        if label == "wire_decode_bench":
+            continue
+        lines.append(f"{label:28s} {s['count']:>8d} {s['mean_us']:>10.1f} "
+                     f"{s['total_ms']:>10.1f} {s['max_us']:>10.1f}")
+    bench = attr.get("wire_decode_bench")
+    if bench:
+        lines.append(f"{'wire decode (validated)':28s} {'-':>8s} "
+                     f"{bench['validated_us']:>10.2f}")
+        lines.append(f"{'wire decode (fast path)':28s} {'-':>8s} "
+                     f"{bench['fast_us']:>10.2f}")
+    return "\n".join(lines)
 
 
 def main() -> None:
@@ -162,11 +234,19 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--local", action="store_true")
     p.add_argument("--scale", type=float, default=1.0)
+    p.add_argument("--attribute", action="store_true",
+                   help="profile the submit path per stage and include "
+                        "the breakdown in the output JSON")
     args = p.parse_args()
     import ray_tpu
 
-    result = run_microbench(local_mode=args.local, scale=args.scale)
+    result = run_microbench(local_mode=args.local, scale=args.scale,
+                            attribute=args.attribute)
     print(json.dumps(result))
+    if args.attribute:
+        import sys
+
+        print(format_attribution(result["attribution"]), file=sys.stderr)
     ray_tpu.shutdown()
 
 

@@ -186,17 +186,23 @@ def span(name: str, *, parent: Optional[str] = None,
     else:
         # Trace root: the head-sampling decision, inherited by every
         # descendant span in every process via the traceparent flags.
-        trace_id = secrets.token_hex(16)
         sampled = _sample_rate >= 1.0 or random.random() < _sample_rate
-    span_id = secrets.token_hex(8)
-    token = _ctx.set((trace_id, span_id, sampled))
+        trace_id = (secrets.token_hex(16) if sampled
+                    else f"{random.getrandbits(128):032x}")
     if not sampled:
+        # Unsampled spans record nothing anywhere; their ids only ever
+        # appear as parent_ids of other never-recorded spans. A PRNG id
+        # keeps this path free of the os.urandom syscall.
+        span_id = f"{random.getrandbits(64):016x}"
+        token = _ctx.set((trace_id, span_id, False))
         try:
             yield {"trace_id": trace_id, "span_id": span_id,
                    "sampled": False}
         finally:
             _ctx.reset(token)
         return
+    span_id = secrets.token_hex(8)
+    token = _ctx.set((trace_id, span_id, True))
     t0 = time.time()
     err: Optional[str] = None
     try:

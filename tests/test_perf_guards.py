@@ -1,19 +1,31 @@
 """Cluster-mode performance floors — regression guards.
 
 Reference equivalent: `python/ray/_private/ray_perf.py` tracked in release
-CI (`release/release_tests.yaml` core microbenchmarks).
+CI as its own serialized stage (`release/release_tests.yaml` core
+microbenchmarks). The serialization here is enforced two ways:
 
-Calibration (recorded so the next recalibration has a baseline): idle
-2-CPU dev box, 2026-08, best of 3 runs at scale 0.3 — tasks ~420-585/s, actor
-calls ~790-990/s, task p50 ~2.3 ms, put/get 10 MB ~8-12/4-7 ms, compiled
-3-actor chain ~1.9-3.1 ms/call vs ~17-29 ms/call for the same chain via
-dag.execute (5.6-8.6x). Floors/ceilings sit at ~50-75% of those bests:
-tight enough that the 40%-class regression round 5 shipped fails the
-suite, loose enough that scheduler noise on a 2-core box does not. The
-round-5 floors (600 tasks/s) were calibrated on a bigger box and failed
-even on an idle run here — a guard that always fails guards nothing, so
-floors are now paired with a best-of-two-rounds measurement: a real
-regression drags the BEST down, one noisy round does not.
+- conftest's `pytest_collection_modifyitems` moves every `perf`-marked
+  test to the very END of a full-suite run, after other modules have
+  torn their clusters down (round 5 measured 143 actor-calls/s when this
+  ran mid-suite — a number about box contention, not the runtime);
+- calibration-grade runs use the stage alone: `pytest -m perf`.
+
+Calibration (idle 2-CPU dev box, 2026-08, post round-6 hot-path recovery;
+fold-best of 2 rounds at scale 0.3, two samples): tasks 631-851/s, actor
+calls 938-986/s, cgraph chain 350-447 calls/s, speedup 6.5-8.9x, task p50
+3.4-4.6 ms, actor p50 3.5-4.3 ms, put/get 10 MB 11-15 / 1.6-2.8 ms.
+Round-6 floors sit at 75-80% of the LOW end of those fresh numbers
+(ceilings at ~125% of the high end): tight enough that a rerun of the
+round-5 regression (-40% tasks/s, would fold to ~380-510/s here) trips
+`tasks_per_s`, loose enough that 2-core scheduler noise does not.
+
+Flake control: violations must survive the fold-best of ALL rounds — a
+real regression drags the best of every round down; one noisy round does
+not. The early exit means a healthy box usually pays 1-2 rounds.
+
+The submit-path attribution breakdown for diagnosing a failure here
+lives one command away: `python -m ray_tpu.perf --attribute` (see
+PROFILE.md for the round-6 table).
 """
 
 import pytest
@@ -23,26 +35,30 @@ from ray_tpu.perf import run_microbench
 pytestmark = [pytest.mark.cluster, pytest.mark.perf]
 
 FLOORS = {
-    "tasks_per_s": 300.0,
-    "actor_calls_per_s": 600.0,
+    "tasks_per_s": 500.0,
+    "actor_calls_per_s": 720.0,
     # The compiled plane's reason to exist: per-call overhead well under
     # the task path. Relative guard (same box state for both sides), so
     # box noise largely cancels.
     "cgraph_vs_dag_speedup": 3.0,
-    "cgraph_calls_per_s": 150.0,
+    "cgraph_calls_per_s": 250.0,
 }
 CEILINGS = {
-    "task_roundtrip_p50_ms": 4.0,
-    "actor_call_p50_ms": 3.5,
-    "put_10mb_ms": 40.0,
-    "get_10mb_ms": 15.0,
-    "cgraph_call_ms": 8.0,
+    "task_roundtrip_p50_ms": 5.5,
+    "actor_call_p50_ms": 5.0,
+    "put_10mb_ms": 22.0,
+    # Node-local gets bypass the raylet round trip entirely (round-6
+    # fast path); the ceiling is now set from sub-3 ms measurements
+    # where round 5 tolerated 15.
+    "get_10mb_ms": 4.0,
+    "cgraph_call_ms": 4.5,
 }
 
-# Two rounds: fail only on two consecutive violations (a real
-# regression drags the best of both down; one noisy round does not).
-# Kept at 2 because each round costs ~45 s of suite budget.
-ROUNDS = 2
+# Fold-best across up to 3 rounds; fail only when the violation survives
+# every round (two-consecutive-violations minimum — round 1 alone never
+# fails the suite). Early exit on a clean fold keeps the healthy-path
+# cost at 1-2 rounds of ~45 s.
+ROUNDS = 3
 
 
 def _violations(best):
@@ -73,6 +89,9 @@ def test_cluster_perf_floors():
             bad = _violations(best)
             if not bad:
                 break  # early exit: all floors met, don't burn suite time
-        assert not bad, f"performance floors violated: {bad}\n{best}"
+        assert not bad, (
+            f"performance floors violated: {bad}\n{best}\n"
+            "attribute the regression with: "
+            "python -m ray_tpu.perf --attribute")
     finally:
         ray_tpu.shutdown()

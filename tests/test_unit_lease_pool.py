@@ -1,0 +1,195 @@
+"""Fast unit tier: the lease-pool reuse state machine (no cluster).
+
+Drives the REAL `_acquire_worker` / `_pump_leases` / `_hand_worker` /
+`_offer_worker` / `_linger_then_return` code on a harness ClusterRuntime
+whose raylet RPCs are in-process fakes. Pins the reuse contract the
+task-plane throughput depends on (reference: direct_task_transport keeps
+leased workers hot): a completed task's worker serves the next
+same-scheduling-key task with NO fresh raylet round trip.
+"""
+
+import asyncio
+
+import pytest
+
+from ray_tpu.core.cluster_runtime import ClusterRuntime, _LeasePool
+from ray_tpu.core.config import ray_config
+
+pytestmark = pytest.mark.unit
+
+
+class _Harness(ClusterRuntime):
+    """ClusterRuntime with only lease-pool state, faked lease RPCs."""
+
+    def __init__(self, fail_first: int = 0):
+        self._lease_pools = {}
+        self._live_leases = []
+        self._pipeline_depth = ray_config().worker_pipeline_depth
+        self._pipeline_svc_threshold = (
+            ray_config().pipeline_service_threshold_s)
+        self.lease_requests = 0
+        self.fail_first = fail_first
+        self.returned = []
+
+    async def _request_lease(self, resources, is_actor=False, bundle=None,
+                             address=None):
+        self.lease_requests += 1
+        if self.lease_requests <= self.fail_first:
+            raise OSError(f"raylet down (simulated #{self.lease_requests})")
+        return {"worker_address": f"w{self.lease_requests}",
+                "worker_id": f"wid{self.lease_requests}",
+                "lease_id": f"l{self.lease_requests}",
+                "raylet_address": "raylet:1"}
+
+    async def _return_worker(self, worker, dead=False):
+        self.returned.append((worker["lease_id"], dead))
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_acquire_grants_via_one_lease_rpc():
+    async def main():
+        rt = _Harness()
+        w = await rt._acquire_worker("k", {"CPU": 1.0})
+        assert w["worker_address"] == "w1"
+        assert rt.lease_requests == 1
+        assert w["avail"] is False   # exclusively promised
+
+    _run(main())
+
+
+def test_offered_worker_reused_without_raylet_round_trip():
+    async def main():
+        rt = _Harness()
+        w = await rt._acquire_worker("k", {"CPU": 1.0})
+        rt._offer_worker("k", w)     # task finished, pipeline == 0
+        w2 = await rt._acquire_worker("k", {"CPU": 1.0})
+        assert w2 is w               # the same hot lease
+        assert rt.lease_requests == 1  # no fresh raylet RPC
+
+    _run(main())
+
+
+def test_offer_hands_directly_to_queued_waiter():
+    async def main():
+        rt = _Harness()
+        w = await rt._acquire_worker("k", {"CPU": 1.0})
+        # Queue a second acquire while the only worker is busy: it must
+        # pipeline a lease request AND still accept the direct handoff
+        # if the first task completes before the raylet answers.
+        acq = asyncio.ensure_future(rt._acquire_worker("k", {"CPU": 1.0}))
+        await asyncio.sleep(0)       # let the waiter register
+        pool = rt._lease_pools["k"]
+        assert len(pool.waiters) == 1
+        rt._offer_worker("k", w)     # direct handoff, no idle detour
+        assert (await acq) is w
+        assert pool.waiters == []
+
+    _run(main())
+
+
+def test_pipelined_offer_gated_on_service_time():
+    async def main():
+        rt = _Harness()
+        w = await rt._acquire_worker("k", {"CPU": 1.0})
+        pool = rt._lease_pools["k"]
+        # pipeline > 0 and unknown service time: NOT recirculated (a
+        # possibly-long task would serialize everything behind it).
+        w["pipeline"] = 1
+        rt._offer_worker("k", w)
+        assert pool.idle == []
+        # Known-fast worker: deep pipelining engages.
+        w["svc_ema"] = rt._pipeline_svc_threshold / 10.0
+        rt._offer_worker("k", w)
+        assert pool.idle == [w]
+        pool.idle.clear()
+        # Known-slow worker: stays out of circulation.
+        w["avail"] = False
+        w["svc_ema"] = rt._pipeline_svc_threshold * 10.0
+        rt._offer_worker("k", w)
+        assert pool.idle == []
+        # Pipeline window exhausted: never recirculated.
+        w["svc_ema"] = 0.0
+        w["pipeline"] = rt._pipeline_depth
+        rt._offer_worker("k", w)
+        assert pool.idle == []
+
+    _run(main())
+
+
+def test_dead_idle_worker_skipped_on_acquire():
+    async def main():
+        rt = _Harness()
+        w = await rt._acquire_worker("k", {"CPU": 1.0})
+        rt._offer_worker("k", w)
+        w["dead"] = True             # died while idling (e.g. OOM kill)
+        w2 = await rt._acquire_worker("k", {"CPU": 1.0})
+        assert w2 is not w
+        assert rt.lease_requests == 2
+
+    _run(main())
+
+
+def test_lease_failure_wakes_one_waiter_and_repumps():
+    async def main():
+        rt = _Harness(fail_first=1)
+        a1 = asyncio.ensure_future(rt._acquire_worker("k", {"CPU": 1.0}))
+        a2 = asyncio.ensure_future(rt._acquire_worker("k", {"CPU": 1.0}))
+        results = await asyncio.gather(a1, a2, return_exceptions=True)
+        failures = [r for r in results if isinstance(r, Exception)]
+        grants = [r for r in results if isinstance(r, dict)]
+        # Exactly one waiter observes the fault (its submit loop
+        # retries); the re-pump keeps the other one served.
+        assert len(failures) == 1 and isinstance(failures[0], OSError)
+        assert len(grants) == 1
+
+    _run(main())
+
+
+def test_idle_lease_lingers_then_returns_to_raylet():
+    async def main():
+        rt = _Harness()
+        w = await rt._acquire_worker("k", {"CPU": 1.0})
+        rt._offer_worker("k", w)
+        pool = rt._lease_pools["k"]
+        assert pool.idle == [w]
+        # _hand_worker scheduled _linger_then_return; after the linger
+        # window the unused lease goes back to the raylet.
+        await asyncio.sleep(ray_config().lease_idle_linger_s + 0.3)
+        assert pool.idle == []
+        assert rt.returned == [("l1", False)]
+
+    _run(main())
+
+
+def test_pump_caps_inflight_lease_rpcs_and_reuse_serves_surplus():
+    async def main():
+        rt = _Harness()
+        pool = rt._lease_pools.setdefault("k", _LeasePool())
+        n = pool.MAX_INFLIGHT + 5
+        acqs = [asyncio.ensure_future(
+            rt._acquire_worker("k", {"CPU": 1.0})) for _ in range(n)]
+        await asyncio.sleep(0)
+        # Pipelined lease requests are bounded per scheduling key
+        # (reference: max_pending_lease_requests_per_scheduling_category).
+        assert pool.inflight_leases <= pool.MAX_INFLIGHT
+        # Surplus waiters beyond the cap are served by REUSE: as each
+        # granted worker "finishes its task" and is offered back, it
+        # hands off to a queued waiter — no further raylet RPCs.
+        workers = []
+        pending = set(acqs)
+        while pending:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED)
+            for d in done:
+                w = d.result()
+                workers.append(w)
+                rt._offer_worker("k", w)
+        assert len(workers) == n
+        assert rt.lease_requests <= pool.MAX_INFLIGHT
+        for w in workers:
+            w["returned"] = True     # silence the linger tasks
+
+    _run(main())

@@ -1,0 +1,150 @@
+"""Fast unit tier: template-spec encoding (golden bytes + invalidation).
+
+The submit hot path re-encodes only ids/args per call from a cached
+template (wire.SpecTemplate). Two things must hold forever:
+
+1. **Golden equivalence** — the bytes msgpack produces from a template
+   encode are IDENTICAL to a full `to_wire` of an equivalently-built
+   validated message, so the receiver cannot tell the paths apart.
+2. **Invalidation** — any options/runtime-env change produces a
+   different template cache key (a fresh validated prototype), so a
+   stale invariant can never ride along.
+"""
+
+import msgpack
+import pytest
+
+from ray_tpu.core.cluster_runtime import ClusterRuntime
+from ray_tpu.core.ids import JobID
+from ray_tpu.core.options import TaskOptions
+from ray_tpu.core.wire import ActorTaskSpec, SpecTemplate, TaskSpec, to_wire
+
+pytestmark = pytest.mark.unit
+
+
+def _packb(d):
+    return msgpack.packb(d, use_bin_type=True)
+
+
+def test_template_encode_bytes_match_validated_encoder():
+    proto = TaskSpec(task_id="aa" * 16, job_id="bb" * 8, name="f",
+                     fn_key="k" * 40, args=b"first", arg_oids=["cc" * 28],
+                     resources={"CPU": 1.0}, owner="127.0.0.1:7",
+                     max_retries=3)
+    tmpl = SpecTemplate(proto)
+    for i in range(3):
+        task_id = f"{i:02x}" * 16
+        args = f"call-{i}".encode()
+        oids = [f"{i:02x}" * 28]
+        enc = tmpl.encode(task_id=task_id, args=args, arg_oids=oids,
+                          trace_ctx=None)
+        golden = to_wire(TaskSpec(
+            task_id=task_id, job_id="bb" * 8, name="f", fn_key="k" * 40,
+            args=args, arg_oids=oids, resources={"CPU": 1.0},
+            owner="127.0.0.1:7", max_retries=3))
+        assert _packb(enc) == _packb(golden)
+
+
+def test_actor_template_encode_bytes_match():
+    proto = ActorTaskSpec(task_id="aa" * 16, job_id="bb" * 8,
+                          actor_id="dd" * 16, method="inc", name="C.inc",
+                          args=b"x", seq=0, owner="127.0.0.1:7")
+    tmpl = SpecTemplate(proto)
+    enc = tmpl.encode(task_id="ee" * 16, args=b"y", seq=7, trace_ctx=None)
+    golden = to_wire(ActorTaskSpec(
+        task_id="ee" * 16, job_id="bb" * 8, actor_id="dd" * 16,
+        method="inc", name="C.inc", args=b"y", seq=7, owner="127.0.0.1:7"))
+    assert _packb(enc) == _packb(golden)
+
+
+def test_template_base_not_mutated_by_encode():
+    proto = TaskSpec(task_id="aa" * 16, job_id="bb" * 8, name="f",
+                     fn_key="k", args=b"first", owner="o")
+    tmpl = SpecTemplate(proto)
+    first = _packb(tmpl.encode(task_id="11" * 16, args=b"A",
+                               arg_oids=["x"], trace_ctx="tp"))
+    # A later call with different values must not see residue.
+    enc = tmpl.encode(task_id="22" * 16, args=b"B", arg_oids=[],
+                      trace_ctx=None)
+    assert enc["args"] == b"B" and enc["trace_ctx"] is None
+    assert _packb(tmpl.encode(task_id="11" * 16, args=b"A",
+                              arg_oids=["x"], trace_ctx="tp")) == first
+
+
+# ----------------------------------------------------------------------
+# The runtime-level cache: repeated submits hit, option changes miss.
+# ----------------------------------------------------------------------
+
+class _FakeFn:
+    _function_name = "fake_fn"
+    _function = None
+
+
+def _harness():
+    rt = ClusterRuntime.__new__(ClusterRuntime)
+    rt._spec_templates = {}
+    rt.job_id = JobID.from_int(7)
+    rt.address = "127.0.0.1:7777"
+    return rt
+
+
+def _opts(**kw):
+    o = TaskOptions()
+    for k, v in kw.items():
+        setattr(o, k, v)
+    return o
+
+
+def test_repeated_submits_share_one_template():
+    rt = _harness()
+    specs = []
+    for i in range(3):
+        spec, sk = rt._encode_task_spec(
+            _FakeFn, _opts(), "fnkey", 1, False,
+            task_id=f"{i:02x}" * 16, args=b"a", arg_oids=[],
+            trace_ctx=None)
+        specs.append((spec, sk))
+    assert len(rt._spec_templates) == 1
+    # Same scheduling key (lease reuse class) for every call.
+    assert len({sk for _, sk in specs}) == 1
+    # Per-call fields differ; invariants identical.
+    assert [s["task_id"] for s, _ in specs] == [
+        f"{i:02x}" * 16 for i in range(3)]
+    assert {s["fn_key"] for s, _ in specs} == {"fnkey"}
+
+
+@pytest.mark.parametrize("change", [
+    {"max_retries": 5},
+    {"num_cpus": 2},
+    {"runtime_env": {"env_vars": {"A": "1"}}},
+])
+def test_option_change_invalidates_template(change):
+    rt = _harness()
+    rt._encode_task_spec(_FakeFn, _opts(), "fnkey", 1, False,
+                         task_id="aa" * 16, args=b"a", arg_oids=[],
+                         trace_ctx=None)
+    spec2, _ = rt._encode_task_spec(
+        _FakeFn, _opts(**change), "fnkey", 1, False,
+        task_id="bb" * 16, args=b"a", arg_oids=[], trace_ctx=None)
+    assert len(rt._spec_templates) == 2   # miss -> fresh prototype
+    if "max_retries" in change:
+        assert spec2["max_retries"] == 5
+    if "num_cpus" in change:
+        assert spec2["resources"]["CPU"] == 2
+    if "runtime_env" in change:
+        assert spec2["runtime_env"] == {"env_vars": {"A": "1"}}
+
+
+def test_runtime_env_change_changes_scheduling_key():
+    # Distinct runtime envs must never share a leased worker: the env
+    # rides the scheduling key (worker-compatibility class).
+    rt = _harness()
+    _, sk_a = rt._encode_task_spec(
+        _FakeFn, _opts(runtime_env={"env_vars": {"A": "1"}}), "fnkey",
+        1, False, task_id="aa" * 16, args=b"", arg_oids=[],
+        trace_ctx=None)
+    _, sk_b = rt._encode_task_spec(
+        _FakeFn, _opts(runtime_env={"env_vars": {"A": "2"}}), "fnkey",
+        1, False, task_id="bb" * 16, args=b"", arg_oids=[],
+        trace_ctx=None)
+    assert sk_a != sk_b

@@ -1,0 +1,178 @@
+"""Fast unit tier: post-handshake wire decode (no sockets, no cluster).
+
+Covers the round-6 tentpole contract: after the schema-digest handshake
+proves both peers encode identically, task-plane decodes take
+`from_wire_fast` (no per-field validation); any envelope shortfall —
+wrong version, missing required field, unknown type — falls back to the
+validated decoder and its typed errors. The handshake state itself is
+produced by the REAL `ServerConnection` dispatch via the loopback fakes
+(core/rpc_testing.py), not a reimplementation.
+"""
+
+import asyncio
+
+import msgpack
+import pytest
+
+from ray_tpu.core import rpc_testing
+from ray_tpu.core.wire import (ActorTaskSpec, SchemaMismatchError, TaskSpec,
+                               WireDecodeError, check_digest, from_wire,
+                               from_wire_fast, schema_digest, to_wire)
+
+pytestmark = pytest.mark.unit
+
+
+def _roundtrip(msg) -> dict:
+    """to_wire + a real msgpack pass (tuples->lists etc.)."""
+    return msgpack.unpackb(
+        msgpack.packb(to_wire(msg), use_bin_type=True), raw=False)
+
+
+def _task_payload(**over) -> dict:
+    base = dict(task_id="ab" * 16, job_id="cd" * 8, name="f",
+                fn_key="k" * 40, args=b"blob", resources={"CPU": 1.0},
+                owner="127.0.0.1:7", arg_oids=["ef" * 28])
+    base.update(over)
+    return _roundtrip(TaskSpec(**base))
+
+
+def test_fast_decode_matches_validated():
+    payload = _task_payload()
+    fast = from_wire_fast(payload, "TaskSpec")
+    slow = from_wire(dict(payload), expect="TaskSpec")
+    assert fast.as_dict() == slow.as_dict()
+    assert isinstance(fast, TaskSpec)
+    # Mapping-protocol surface the handlers rely on survives the fast
+    # construction path.
+    assert fast["task_id"] == "ab" * 16
+    assert fast.get("missing", 42) == 42
+    assert "fn_key" in fast
+
+
+def test_fast_decode_fills_defaults_and_factories():
+    payload = _task_payload()
+    # A sparse payload (older peer omitting optional fields) still
+    # decodes with defaults; factory fields get fresh containers.
+    for k in ("num_returns", "arg_oids", "resources", "streaming",
+              "max_retries", "runtime_env", "pg", "visible_chips",
+              "trace_ctx"):
+        payload.pop(k, None)
+    a = from_wire_fast(payload, "TaskSpec")
+    b = from_wire_fast(dict(payload), "TaskSpec")
+    assert a.num_returns == 1 and a.streaming is False
+    assert a.arg_oids == [] and a.resources == {}
+    a.arg_oids.append("x")
+    assert b.arg_oids == []   # no shared mutable default
+
+
+def test_fast_decode_missing_required_falls_back_to_typed_error():
+    payload = _task_payload()
+    del payload["fn_key"]
+    with pytest.raises(WireDecodeError, match="fn_key"):
+        from_wire_fast(payload, "TaskSpec")
+
+
+def test_fast_decode_version_mismatch_falls_back():
+    payload = _task_payload()
+    payload["_v"] = 99
+    with pytest.raises(SchemaMismatchError):
+        from_wire_fast(payload, "TaskSpec")
+
+
+def test_fast_decode_unknown_type_and_wrong_expect():
+    with pytest.raises(WireDecodeError):
+        from_wire_fast({"_t": "NoSuchMessage", "_v": 1}, None)
+    payload = _task_payload()
+    with pytest.raises(WireDecodeError, match="expected"):
+        from_wire_fast(payload, "ActorTaskSpec")
+
+
+def test_fast_decode_carries_unknown_newer_fields():
+    payload = _task_payload()
+    payload["future_field"] = 7
+    msg = from_wire_fast(payload, "TaskSpec")
+    assert msg["future_field"] == 7
+    assert msg.as_dict()["future_field"] == 7
+
+
+def test_actor_spec_fast_decode():
+    payload = _roundtrip(ActorTaskSpec(
+        task_id="ab" * 16, job_id="cd" * 8, actor_id="99" * 16,
+        method="inc", name="C.inc", args=b"x", seq=5))
+    fast = from_wire_fast(payload, "ActorTaskSpec")
+    assert fast.seq == 5 and fast.method == "inc"
+    assert fast.as_dict() == from_wire(
+        dict(payload), expect="ActorTaskSpec").as_dict()
+
+
+# ----------------------------------------------------------------------
+# Handshake -> connection fast-path state, through the real dispatch.
+# ----------------------------------------------------------------------
+
+class _Handlers:
+    async def handle_echo(self, conn, **kw):
+        return kw
+
+
+def test_loopback_handshake_unlocks_wire_fast():
+    async def run():
+        client = rpc_testing.LoopbackClient(_Handlers())
+        await client.connect()   # digest exchange both ways
+        assert client.conn.metadata.get("wire_fast") is True
+        assert await client.call("echo", x=1) == {"x": 1}
+
+    asyncio.run(run())
+
+
+def test_loopback_handshake_digest_mismatch_stays_validated():
+    async def run():
+        client = rpc_testing.LoopbackClient(_Handlers())
+        # Simulate a peer whose TaskSpec is a different version. (The
+        # loopback client shares this process's registry, so only the
+        # SERVER side of the mismatch is observable here; the client
+        # side of the same check is covered by check_digest directly.)
+        bad = dict(schema_digest())
+        bad["TaskSpec"] = 99
+        await client.connect(digest=bad)
+        # Server refused the fast path for this connection: every decode
+        # stays validated.
+        assert client.conn.metadata.get("wire_fast") is False
+        with pytest.raises(SchemaMismatchError):
+            check_digest(bad)
+
+    asyncio.run(run())
+
+
+def test_legacy_client_without_digest_stays_validated():
+    async def run():
+        client = rpc_testing.LoopbackClient(_Handlers())
+        client.conn = rpc_testing.make_server_connection(_Handlers())
+        client.connected = True
+        # Pre-round-6 client: calls __schema__ with no digest argument.
+        digest = await client.call("__schema__")
+        assert digest == schema_digest()
+        assert "wire_fast" not in client.conn.metadata
+
+    asyncio.run(run())
+
+
+def test_decode_spec_dispatches_on_connection_state():
+    """ClusterRuntime._decode_spec picks the decoder per connection."""
+    from ray_tpu.core.cluster_runtime import ClusterRuntime
+
+    rt = ClusterRuntime.__new__(ClusterRuntime)
+    payload = _task_payload()
+
+    async def run():
+        conn = rpc_testing.make_server_connection(_Handlers())
+        # No handshake: validated path (malformed payload raises).
+        bad = dict(payload)
+        bad["num_returns"] = "three"
+        with pytest.raises(WireDecodeError):
+            rt._decode_spec(conn, bad, "TaskSpec")
+        conn.metadata["wire_fast"] = True
+        out = rt._decode_spec(conn, dict(payload), "TaskSpec")
+        assert out.as_dict() == from_wire(
+            dict(payload), expect="TaskSpec").as_dict()
+
+    asyncio.run(run())
