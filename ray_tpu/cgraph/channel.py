@@ -19,7 +19,17 @@ IntraProcessChannel / shared-memory Channel / accelerator channel split:
   co-located handoff keeps the `jax.Array` on device untouched;
   cross-process handoff moves host bytes and re-lands them on device via
   `util.device_arrays.to_jax` (CPU: dlpack alias; TPU: one host->HBM
-  DMA, the physical minimum).
+  DMA, the physical minimum). Remote pushes ride the RPC layer's blob
+  frames (rpc.py `_blob`): the array buffer goes to the transport as a
+  view and arrives as one dedicated buffer the reader aliases — no
+  msgpack re-embedding copy on either side;
+- `DeviceChannel` (`.with_channel("device")`): when writer and reader
+  both hold ranks in a shared `util.collective` group, the tensor moves
+  writer->reader via collective p2p send/recv (gloo today, ICI when the
+  group is device-backed) — only a tiny dtype/shape header rides the
+  RPC push path (preserving FIFO seq semantics); the payload never
+  transits the RPC data plane at all. Endpoints without group ranks
+  fall back to the ArrayChannel push transport transparently.
 
 A channel is a fixed slot queue reused for every execution (capacity
 bounds in-flight executions per edge), unlike task returns which
@@ -30,6 +40,7 @@ from __future__ import annotations
 
 import secrets
 import threading
+import time
 from collections import deque
 from typing import Any, Dict, Optional
 
@@ -85,6 +96,10 @@ def unregister(channel_id: str) -> None:
 
 _KINDS: Dict[str, type] = {}
 
+# Frames at or below this size are embedded in the msgpack push body
+# (coalescable); larger payloads ship out of band as blob frames.
+_INLINE_PUSH_MAX = 64 * 1024
+
 
 def deposit_remote(kind: str, channel_id: str, capacity: int, blob: bytes,
                    seq: int, timeout: float = 600.0,
@@ -136,14 +151,22 @@ class Channel:
         # not reorder a FIFO edge); reader-side: next seq to admit.
         self._wseq = 0
         self._rseq = 0
-        # Reused frame buffer: cross-process pushes serialize into the
-        # same bytearray every execution instead of reallocating.
-        self._framebuf = bytearray()
         # In-flight push ACK futures: pushes are PIPELINED — a write
         # fires the frame and returns; the ACK (which the reader delays
         # while its slot is full) is awaited only when `capacity` pushes
         # are outstanding. Backpressure with no per-write round-trip.
         self._acks: deque = deque()
+        # Device-transport route cache (DeviceChannel): resolved lazily
+        # on first remote write; transient failures retry up to
+        # _ROUTE_RETRY_BUDGET before the edge settles on push.
+        self._route = None
+        self._route_resolved = False
+        self._route_attempts = 0
+        # Writers that keep owning the value after write() (the driver's
+        # input edges) must not have a live view of it shipped through
+        # the pipelined async push — the compiler sets this and the
+        # array codec snapshots the buffer instead.
+        self._snapshot_writes = False
 
     def __reduce__(self):
         return (get_or_create,
@@ -151,12 +174,16 @@ class Channel:
                  self._ordered))
 
     # -- codec ----------------------------------------------------------
-    def _encode(self, value: Any) -> bytes:
-        self._framebuf.clear()
-        serialization.serialize_fast_into(value, self._framebuf)
-        return bytes(self._framebuf)
+    def _encode_chunks(self, value: Any) -> list:
+        """The frame as a chunk list for the transport. One serialize
+        copy into a fresh buffer; the RPC blob framing ships the chunks
+        out of band, so there is no bytes() snapshot and no msgpack
+        re-embedding copy (the round-6 path paid both)."""
+        buf = bytearray()
+        serialization.serialize_fast_into(value, buf)
+        return [buf]
 
-    def _decode(self, blob: bytes) -> Any:
+    def _decode(self, blob, timeout: Optional[float] = None) -> Any:
         return serialization.deserialize_fast(blob)
 
     # -- local side ------------------------------------------------------
@@ -228,7 +255,11 @@ class Channel:
         if self._is_local_writer():
             self._write_local(value, timeout)
             return
-        blob = self._encode(value)
+        self._push_chunks(self._encode_chunks(value), timeout)
+
+    def _push_chunks(self, chunks: list,
+                     timeout: Optional[float]) -> None:
+        """Fire one seq-stamped frame at the reader (pipelined)."""
         seq = self._wseq
         self._wseq += 1
         from ray_tpu.core.worker import current_runtime
@@ -247,7 +278,7 @@ class Channel:
             except Exception as e:  # noqa: BLE001
                 self._raise_push_failure(e)
         self._acks.append(asyncio.run_coroutine_threadsafe(
-            self._push_remote(rt, blob, seq, timeout), rt._loop.loop))
+            self._push_remote(rt, chunks, seq, timeout), rt._loop.loop))
 
     def _reap(self, fut) -> None:
         try:
@@ -283,15 +314,32 @@ class Channel:
             except Exception as e:  # noqa: BLE001
                 self._raise_push_failure(e)
 
-    async def _push_remote(self, rt, blob: bytes, seq: int,
+    async def _push_remote(self, rt, chunks: list, seq: int,
                            timeout: Optional[float]) -> None:
         client = await rt._worker_client(self.reader_addr)
+        total = sum(len(c) for c in chunks)
+        if total <= _INLINE_PUSH_MAX:
+            # Small frames ride the ordinary msgpack body so the batched
+            # writer keeps coalescing a burst of pushes into one syscall
+            # — blob framing forces a flush per frame, which costs more
+            # than the one small copy it avoids (round-7 guard: the
+            # 3-actor-chain rate halved when every push took the blob
+            # path).
+            await client.call(
+                "cgraph_push", kind=self.kind, channel=self.id,
+                capacity=self.capacity, seq=seq, ordered=self._ordered,
+                timeout=timeout,
+                data=bytes(chunks[0]) if len(chunks) == 1
+                else b"".join(chunks))
+            return
         await client.call("cgraph_push", kind=self.kind, channel=self.id,
-                          capacity=self.capacity, data=blob, seq=seq,
+                          capacity=self.capacity, _blob=chunks, seq=seq,
                           ordered=self._ordered, timeout=timeout)
 
     def read(self, timeout: Optional[float] = None) -> Any:
         """Blocking read (reader process only)."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
         with self._cond:
             if not self._cond.wait_for(
                     lambda: self._buf or self._closed, timeout=timeout):
@@ -301,7 +349,12 @@ class Channel:
             item = self._buf.popleft()
             self._cond.notify_all()
         if isinstance(item, _WireBlob):
-            return self._decode(item.blob)
+            # Decode may itself block (DeviceChannel waits on a p2p
+            # recv): pass the caller's remaining budget along so a
+            # bounded read stays bounded end to end.
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            return self._decode(item.blob, remaining)
         return item
 
     def try_read(self) -> Any:
@@ -329,23 +382,43 @@ class Channel:
 class ArrayChannel(Channel):
     """Channel for jax/numpy arrays: co-located handoff passes the device
     array by reference (stays on device, zero copies); cross-process
-    handoff ships host bytes and re-lands them on device at the reader
-    (`util.device_arrays.to_jax`). Non-tensor payloads (dicts, strings,
+    handoff ships the host buffer as an out-of-band blob chunk and
+    re-lands it on device at the reader (`util.device_arrays.to_jax`
+    over a view of the wire buffer — CPU: dlpack alias, zero copies;
+    TPU: one host->HBM DMA). Non-tensor payloads (dicts, strings,
     errors) pass through the ordinary codec untouched."""
 
     kind = "array"
 
-    def _encode(self, value: Any) -> bytes:
+    def _encode_chunks(self, value: Any) -> list:
         import numpy as np
         if _is_array_like(value) and not isinstance(value, np.ndarray):
             try:
                 value = np.asarray(value)  # device -> host (one copy max)
             except Exception:
                 pass
-        return super()._encode(value)
+        if (type(value) is np.ndarray and value.dtype.kind not in "OV"
+                and value.flags.c_contiguous):
+            # The array buffer goes to the transport as a VIEW — zero
+            # writer-side copies. Contract: the producer hands the value
+            # off and must not mutate it afterwards (compiled-graph ops
+            # return a fresh array per iteration, which is exactly that).
+            # Edges written by the DRIVER carry user-owned arrays with
+            # no such contract: the compiler marks those channels
+            # `_snapshot_writes` and the frame is built over a private
+            # copy instead.
+            if self._snapshot_writes:
+                value = value.copy()
+            return serialization.pack_array_chunks(value)
+        return super()._encode_chunks(value)
 
-    def _decode(self, blob: bytes) -> Any:
-        value = super()._decode(blob)
+    def _decode(self, blob, timeout: Optional[float] = None) -> Any:
+        if not isinstance(blob, (bytes, bytearray, memoryview)):
+            # Already a decoded value (e.g. a device array deposited by
+            # the device transport): never round-trip it through host
+            # bytes again.
+            return blob
+        value = super()._decode(blob, timeout)
         if _is_error(value):
             return value
         import numpy as np
@@ -356,6 +429,156 @@ class ArrayChannel(Channel):
             except Exception:
                 return value
         return value
+
+
+# Deadline for a device-transport p2p wait whose peer may have died
+# (gloo has no liveness signal of its own — see DeviceChannel).
+_P2P_TIMEOUT_S = 600.0
+
+
+class DeviceChannel(ArrayChannel):
+    """Array channel whose data plane is collective p2p: when both
+    endpoints hold ranks in a shared `util.collective` group, only a
+    dtype/shape header rides the RPC push path (keeping FIFO seq
+    semantics and backpressure); the tensor itself moves writer->reader
+    via `collective.send`/`recv` over the group's fabric (gloo ring
+    today; ICI once the group is device-backed). Reader-side recv runs
+    on the consumer thread in `_decode` — in arrival order, so p2p
+    matching stays FIFO per edge (each channel uses its own tag).
+    Either endpoint lacking a group rank falls back to the ArrayChannel
+    push transport for that value."""
+
+    kind = "device"
+
+    def _tag(self) -> int:
+        # Stable per-edge tag so several device channels between the
+        # same rank pair never cross-match.
+        import zlib
+        return zlib.crc32(self.id.encode()) & 0x3FFFFFFF
+
+    _ROUTE_RETRY_BUDGET = 3
+
+    def _route_retry(self) -> None:
+        """Count a transient route-resolution failure (either endpoint
+        mid-startup, RPC hiccup): retried on later writes until the
+        budget runs out, so one early race does not silently downgrade
+        the edge to the push transport for the channel's lifetime — but
+        an endpoint that truly never joins a group settles on push."""
+        self._route_attempts += 1
+        if self._route_attempts >= self._ROUTE_RETRY_BUDGET:
+            self._route_resolved = True
+
+    def _ensure_route(self):
+        """(group_name, my_rank, reader_rank) or None. A DEFINITIVE
+        answer (both endpoints reached, no shared group after the retry
+        budget) is cached forever; transient failures retry via
+        `_route_retry`."""
+        if self._route_resolved:
+            return self._route
+        self._route = None
+        try:
+            from ray_tpu.util import collective
+            if self.reader_addr is None:
+                self._route_resolved = True   # definitive: no reader
+                return None
+            mine = collective.local_ranks()
+            if not mine:
+                # This side may not have run init_collective_group yet.
+                self._route_retry()
+                return None
+            from ray_tpu.core.worker import current_runtime
+            rt = current_runtime()
+
+            async def _ask():
+                client = await rt._worker_client(self.reader_addr)
+                return await client.call("collective_ranks", timeout=10.0)
+
+            theirs = rt._loop.run(_ask(), timeout=15) or {}
+            for group, rank in mine.items():
+                dst = theirs.get(group)
+                if isinstance(dst, int) and dst != rank:
+                    self._route = (group, rank, dst)
+                    self._route_resolved = True
+                    break
+            else:
+                # The reader answered but shares no group YET — maybe a
+                # race with its own init_collective_group.
+                self._route_retry()
+        except Exception:
+            self._route_retry()
+        return self._route
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        if self._closed:
+            raise ChannelClosed(self.id)
+        if self._is_local_writer():
+            self._write_local(value, timeout)
+            return
+        route = self._ensure_route()
+        if (route is None or not _is_array_like(value)
+                or _is_error(value)):
+            super().write(value, timeout)
+            return
+        import msgpack
+        import numpy as np
+        group, src, dst = route
+        arr = np.ascontiguousarray(np.asarray(value))
+        if arr.dtype.kind in "OV":
+            # Extension/object dtypes (bfloat16 et al.): no torch/gloo
+            # wire form and dtype.str round-trips to raw void — ride
+            # the push transport (which pickles them correctly).
+            super().write(value, timeout)
+            return
+        header = b"D" + msgpack.packb(
+            {"d": arr.dtype.str, "s": list(arr.shape), "src": src,
+             "g": group, "t": self._tag()})
+        # Header first (seq-ordered push: slot admission + backpressure),
+        # then the payload over the collective fabric. The send wait is
+        # BOUNDED: a reader that dies between admitting the header and
+        # posting its recv must surface an error here, not park this
+        # loop thread in gloo forever.
+        self._push_chunks([header], timeout)
+        from ray_tpu.util import collective
+        collective.send(arr, dst, group_name=group, tag=self._tag(),
+                        timeout=timeout or _P2P_TIMEOUT_S)
+        from ray_tpu.core import attribution
+        if attribution.enabled:
+            attribution.count("chan.device_send")
+
+    def _decode(self, blob, timeout: Optional[float] = None) -> Any:
+        if isinstance(blob, (bytes, bytearray, memoryview)):
+            view = memoryview(blob)
+            if view[:1] == b"D":
+                import msgpack
+                import numpy as np
+                head = msgpack.unpackb(bytes(view[1:]))
+                from ray_tpu.util import collective
+                # The header has been consumed from the slot queue, so
+                # this recv MUST complete (or fail the edge): bailing
+                # out early — e.g. with the few-ms budget of a polling
+                # read — would drop the frame while the writer's send
+                # stays in flight, and the next recv on this tag would
+                # FIFO-match the stale tensor (silent data desync). The
+                # caller's read timeout bounds waiting for an item to
+                # ARRIVE; delivery of an admitted frame is bounded only
+                # by the p2p deadline, and a writer dead mid-transfer
+                # fails the edge loudly rather than desyncing it.
+                try:
+                    out = collective.recv(
+                        np.empty(head["s"], np.dtype(head["d"])),
+                        head["src"], group_name=head["g"],
+                        tag=head.get("t", 0), timeout=_P2P_TIMEOUT_S)
+                except TimeoutError as e:
+                    self._closed = True
+                    raise ChannelClosed(
+                        f"{self.id}: device-transport writer never "
+                        f"delivered an admitted frame: {e}") from e
+                from ray_tpu.util.device_arrays import to_jax
+                try:
+                    return to_jax(out)
+                except Exception:
+                    return out
+        return super()._decode(blob)
 
 
 def _is_error(value: Any) -> bool:
@@ -377,3 +600,4 @@ def _is_array_like(value: Any) -> bool:
 
 _KINDS["obj"] = Channel
 _KINDS["array"] = ArrayChannel
+_KINDS["device"] = DeviceChannel

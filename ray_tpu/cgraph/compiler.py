@@ -378,8 +378,14 @@ def compile_dag(output_node, *, max_in_flight: int = 8,
         if isinstance(arg, InputNode):
             key = ("in", id(arg), consumer_i)
             if key not in edge_channels:
-                ch = Channel(capacity=capacity,
-                             reader_addr=actor_addr(op_aids[consumer_i]))
+                ch = channel_cls(arg)(
+                    capacity=capacity,
+                    reader_addr=actor_addr(op_aids[consumer_i]))
+                # The driver keeps owning execute()'s input value after
+                # write() returns — unlike loop actors, it is under no
+                # fresh-array-per-iteration contract, so array codecs
+                # must snapshot rather than ship a live view.
+                ch._snapshot_writes = True
                 edge_channels[key] = ch
                 input_channels.append(ch)
             return ("chan", edge_channels[key])

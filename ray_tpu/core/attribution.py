@@ -28,10 +28,21 @@ Labels in the submit-path breakdown (see `python -m ray_tpu.perf
 - ``rpc.frame_write``   transport write syscalls (batched writer)
 - ``wire.decode``       validated from_wire (whichever process decodes)
 - ``wire.decode_fast``  post-handshake fast-path decode
-- ``worker.decode``     worker-side task-spec decode (from replies)
-- ``worker.exec``       worker-side execute wall time (from replies)
+- ``worker.decode``       worker-side task-spec decode (from replies)
+- ``worker.arg_resolve``  worker-side arg deserialization + ref fetches
+- ``worker.exec``         worker-side user-code wall time
+- ``worker.result_pack``  worker-side return serialization + store
 - ``get.local_shm``     node-local shm reads that bypassed the raylet
 - ``get.pull_rpc``      gets that did take the raylet pull_object RPC
+
+Data-plane counters (round 7, the zero-copy audit — counts, not
+durations): ``get.nd_view`` array gets served as a zero-copy view over
+the store segment (no pickler ran); ``put.sharded``/``get.sharded``
+manifest-based multi-device array put/get; ``chan.device_send``
+device-channel tensors that moved over collective p2p instead of the
+RPC byte plane. A hot array path that is truly zero-copy shows ONLY
+these counters — any ``copy.*`` label appearing next to them names the
+stage that still copies.
 """
 
 from __future__ import annotations

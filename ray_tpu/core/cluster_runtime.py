@@ -57,6 +57,10 @@ logger = logging.getLogger(__name__)
 # task args — the submitter pins those for the task's duration).
 _deser_ctx = threading.local()
 
+# "Not resolvable on this thread" sentinel for _read_resolved_local
+# (None is a legitimate stored value).
+_MISS = object()
+
 INLINE_LIMIT_KEY = "max_direct_call_object_size"
 
 
@@ -205,6 +209,9 @@ class ClusterRuntime:
         # Node-local shm objects this process wrote (put path): get()
         # reads them back without the raylet pull_object round trip.
         self._local_shm: Dict[str, dict] = {}
+        # Sharded puts: manifest oid -> shard oids (each shard holds one
+        # reference released when the manifest entry dies).
+        self._shard_children: Dict[str, List[str]] = {}
         # Syscall caches: getpid costs ~20 us on virtualized hosts and
         # the task path reads it 3x per task; config attribute reads do
         # an os.environ lookup each. Snapshot both per process.
@@ -600,6 +607,9 @@ class ClusterRuntime:
             del self._owned[oid]
             nodes = list(entry.nodes)
         self._release_shm_mapping(oid)
+        for child in self._shard_children.pop(oid, ()):
+            # Shard objects live exactly as long as their manifest.
+            self.remove_local_reference(ObjectID(bytes.fromhex(child)))
         rec = self._lineage.pop(oid, None)
         if rec is not None:
             rec["live"] -= 1
@@ -754,6 +764,10 @@ class ClusterRuntime:
     def put(self, value: Any) -> ObjectRef:
         if isinstance(value, ObjectRef):
             raise TypeError("Calling put() on an ObjectRef is not allowed.")
+        from ray_tpu.util import device_arrays as _da
+
+        if _da.is_multishard(value):
+            return self._put_sharded(value)
         task_id = TaskID.for_task(self.job_id)
         object_id = ObjectID.for_put(task_id, self._put_counter.next())
         oid = object_id.hex()
@@ -761,6 +775,121 @@ class ClusterRuntime:
         entry = self._owned_entry(oid)
         self._store_serialized(oid, so, entry)
         return ObjectRef(object_id, owner=self.address, runtime=self)
+
+    def _put_sharded(self, value: Any) -> ObjectRef:
+        """Sharded put of a multi-device jax.Array: exactly one store
+        object per addressable shard (array-native format, no pickle)
+        plus one manifest object; the returned ref names the manifest.
+        Shard objects live exactly as long as the manifest object — each
+        holds one reference released when the manifest entry dies."""
+        from ray_tpu.util import device_arrays as _da
+
+        task_id = TaskID.for_task(self.job_id)
+
+        def store_shard(np_view) -> str:
+            object_id = ObjectID.for_put(task_id, self._put_counter.next())
+            oid = object_id.hex()
+            so = serialization.serialize_array(np_view)
+            entry = self._owned_entry(oid)
+            entry.refcount += 1   # held by the manifest (child pin)
+            self._store_serialized(oid, so, entry)
+            return oid
+
+        stored: List[str] = []
+
+        def store_shard_tracked(np_view) -> str:
+            oid = store_shard(np_view)
+            stored.append(oid)
+            return oid
+
+        try:
+            manifest = _da.build_manifest(value, store_shard_tracked)
+            manifest.owner = self.address
+            object_id = ObjectID.for_put(task_id, self._put_counter.next())
+            mid = object_id.hex()
+            so = serialization.serialize(manifest)
+            entry = self._owned_entry(mid)
+            self._store_serialized(mid, so, entry)
+        except BaseException:
+            # Shard storage OR the manifest store failed partway: the
+            # already-stored shards hold a manifest pin that no manifest
+            # will ever release — drop them now or they stay pinned in
+            # the store until process shutdown.
+            for oid in stored:
+                try:
+                    self.remove_local_reference(
+                        ObjectID(bytes.fromhex(oid)))
+                except Exception:
+                    pass
+            raise
+        self._shard_children[mid] = list(manifest.shard_oids)
+        if attribution.enabled:
+            attribution.count("put.sharded")
+        return ObjectRef(object_id, owner=self.address, runtime=self)
+
+    def _maybe_assemble(self, value: Any,
+                        timeout: Optional[float] = None) -> Any:
+        """Reassemble a sharded array from its manifest: fetch only the
+        locally-addressable shards (zero-copy shm views) and land each
+        on its own device — no host-side gather of the full array."""
+        from ray_tpu.util import device_arrays as _da
+
+        if not isinstance(value, _da.ShardManifest):
+            return value
+        return self._assemble_all([value], timeout)[0]
+
+    def _assemble_all(self, values: List[Any],
+                      timeout: Optional[float] = None) -> List[Any]:
+        """Reassemble every ShardManifest in `values` (others pass
+        through), resolving ALL manifests' not-yet-local shards in ONE
+        gathered batch — a get(list) of k borrower-side manifests costs
+        one pull round-trip latency, not k, and within each manifest
+        the shards resolve concurrently too."""
+        from ray_tpu.util import device_arrays as _da
+
+        manifests = [v for v in values
+                     if isinstance(v, _da.ShardManifest)]
+        if not manifests:
+            return values
+        import jax
+
+        local_ids = {d.id for d in jax.local_devices()}
+        fetched: Dict[str, Any] = {}
+        pending: List[Tuple[str, str]] = []   # (oid, owner_addr)
+        for m in manifests:
+            owner = m.owner or self.address
+            for oid, did in zip(m.shard_oids, m.shard_device_ids):
+                if did not in local_ids or oid in fetched:
+                    continue   # another host's shard: never touched here
+                got = self._read_resolved_local(oid)
+                if got is not _MISS:
+                    fetched[oid] = got   # writer-local: dict hit + view
+                elif all(o != oid for o, _ in pending):
+                    pending.append((oid, owner))
+        if pending:
+            async def _all():
+                return await asyncio.gather(*(
+                    self._resolve_async(
+                        ObjectRef(ObjectID(bytes.fromhex(o)),
+                                  owner=own, runtime=self), timeout)
+                    for o, own in pending))
+            for (o, _), res in zip(pending,
+                                   self._loop.run(_all(), timeout=None)):
+                fetched[o] = self._materialize(res)
+        if attribution.enabled:
+            attribution.count("get.sharded", len(manifests))
+        out = [(_da.assemble_from_manifest(v, lambda oid: fetched[oid])
+                if isinstance(v, _da.ShardManifest) else v)
+               for v in values]
+        # Pulled shards were resolved through bare ObjectRefs that never
+        # registered a borrow, so no later release will ever unmap them
+        # — drop their mappings here, now that assembly has landed every
+        # shard on its device (a still-live view defers the close). The
+        # writer-local `_read_resolved_local` hits stay mapped: their
+        # lifetime belongs to the owned manifest entry.
+        for o, _ in pending:
+            self._release_shm_mapping(o)
+        return out
 
     def _store_serialized(self, oid: str, so, entry: _Owned) -> None:
         size = so.total_size()
@@ -778,7 +907,12 @@ class ClusterRuntime:
         # raylet round trip (pull_object exists for REMOTE resolution;
         # a node-local read needs neither the RPC nor any pull-manager
         # bookkeeping). Invalidation: try_attach fails after eviction.
+        # The writer also keeps the segment MAPPED (plasma clients keep
+        # their store files mmapped): a local get of a just-put object
+        # is then a dict hit + np view — no shm_open/mmap on the read
+        # path. `_release_shm_mapping` drops it with the last local ref.
         self._local_shm[oid] = {"shm_name": shm_name, "size": size}
+        self._shm.try_attach(shm_name)
         if self.raylet_address not in entry.nodes:
             entry.nodes.append(self.raylet_address)
         entry.is_stored = True
@@ -889,28 +1023,35 @@ class ClusterRuntime:
             return self._deserialize_payload(payload)
         return self._read_local_shm(payload, oid)
 
-    def _fetch(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
-        """Blocking fetch of one object's value.
-
-        Resolved-owned fast path: when the result already landed (inline
-        future done, or a node-local segment we wrote), the value is read
-        on THIS thread — no event-loop round trip, which costs a
-        self-pipe write plus a futex wait per call and dominated the
-        sequential-get p50 on syscall-expensive hosts."""
-        oid = ref.hex()
+    def _read_resolved_local(self, oid: str) -> Any:
+        """Thread-local read of an already-landed owned object (inline
+        result, or a node-local shm segment we wrote): no event-loop
+        round trip — that costs a self-pipe write plus a futex wait per
+        call and dominated the sequential-get p50 on syscall-expensive
+        hosts. Returns the `_MISS` sentinel when resolution needs IO."""
         with self._owned_lock:
             entry = self._owned.get(oid)
-        if entry is not None and entry.fut.done():
-            kind, payload = entry.fut.result()
-            if kind == "inline":
-                return self._deserialize_payload(payload)
-            info = self._local_shm.get(oid)
-            if info is not None and self._shm.try_attach(info["shm_name"]):
-                if attribution.enabled:
-                    attribution.count("get.local_shm")
-                return self._read_local_shm(info, oid)
-        return self._materialize(
-            self._loop.run(self._resolve_async(ref, timeout), timeout=None))
+        if entry is None or not entry.fut.done():
+            return _MISS
+        kind, payload = entry.fut.result()
+        if kind == "inline":
+            return self._deserialize_payload(payload)
+        info = self._local_shm.get(oid)
+        if info is not None and self._shm.try_attach(info["shm_name"]):
+            if attribution.enabled:
+                attribution.count("get.local_shm")
+            return self._read_local_shm(info, oid)
+        return _MISS
+
+    def _fetch(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
+        """Blocking fetch of one object's value (resolved-owned objects
+        read on the caller's thread via `_read_resolved_local`)."""
+        value = self._read_resolved_local(ref.hex())
+        if value is not _MISS:
+            return self._maybe_assemble(value, timeout)
+        return self._maybe_assemble(self._materialize(
+            self._loop.run(self._resolve_async(ref, timeout),
+                           timeout=None)), timeout)
 
     def _in_executing_task(self) -> bool:
         return (self.mode == "worker" and threading.get_ident()
@@ -988,7 +1129,8 @@ class ClusterRuntime:
                 *(self._resolve_async(r, timeout) for r in ref_list))
 
         resolved = self._loop.run(_resolve_all(), timeout=None)
-        return [self._materialize(r) for r in resolved]
+        return self._assemble_all(
+            [self._materialize(r) for r in resolved], timeout)
 
     async def _ask_owner_locations_batch(self, owner_addr: str,
                                          oids: List[str]):
@@ -2766,6 +2908,13 @@ class ClusterRuntime:
         ok = False
         arg_refs: List[tuple] = []
         args = kwargs = value = None
+        # Worker-side attribution split: arg-resolution vs exec vs
+        # result-packaging, so a copy regression in either data-plane
+        # half (arg fetch, return store) is attributable separately
+        # from user compute (rides the reply as attr_exec).
+        attr_on = attribution.enabled
+        split = {"arg_resolve": 0, "exec": 0, "result_pack": 0}
+        _tmark = time.perf_counter() if attr_on else 0.0
         try:
             if task_id in self._cancelled_pending:
                 raise TaskCancelledError(task_id)
@@ -2777,6 +2926,10 @@ class ClusterRuntime:
                 apply_runtime_env(self, spec["runtime_env"])
             fn = self._fn.fetch(spec["fn_key"])
             args, kwargs, arg_refs = self._resolve_task_args(spec["args"])
+            if attr_on:
+                now = time.perf_counter()
+                split["arg_resolve"] = int((now - _tmark) * 1e6)
+                _tmark = now
             if tracing_enabled() or spec.get("trace_ctx"):
                 # Execution span parents to the CALLER's span via the
                 # propagated traceparent (reference: tracing_helper's
@@ -2788,9 +2941,16 @@ class ClusterRuntime:
                     value = fn(*args, **kwargs)
             else:
                 value = fn(*args, **kwargs)
+            if attr_on:
+                now = time.perf_counter()
+                split["exec"] = int((now - _tmark) * 1e6)
+                _tmark = now
             args = kwargs = None
             results = self._package_returns(task_id, num_returns, name,
                                             value)
+            if attr_on:
+                split["result_pack"] = int(
+                    (time.perf_counter() - _tmark) * 1e6)
             ok = True
         except BaseException as e:  # noqa: BLE001
             self._die_if_orphaned()
@@ -2807,6 +2967,8 @@ class ClusterRuntime:
                 task_id, name, "FINISHED" if ok else "FAILED",
                 job_id=spec.get("job_id"))
             _reset_task_context(token)
+        if attr_on:
+            return {"results": results, "attr_exec": split}
         return {"results": results}
 
     def _package_returns(self, task_id: str, num_returns: int, name: str,
@@ -2886,9 +3048,11 @@ class ClusterRuntime:
         reply = await loop.run_in_executor(
             self._exec_pool, self._execute_task, spec)
         if attr_on:
-            reply["attr"] = {
-                "decode": int((_t1 - _t0) * 1e6),
-                "exec": int((time.perf_counter() - _t1) * 1e6)}
+            # decode measured here; the arg-resolve/exec/result-pack
+            # split rides out of _execute_task (attr_exec).
+            attr = {"decode": int((_t1 - _t0) * 1e6)}
+            attr.update(reply.pop("attr_exec", None) or {})
+            reply["attr"] = attr
         return reply
 
     async def _execute_streaming(self, spec: dict, actor: bool) -> dict:
@@ -3037,11 +3201,19 @@ class ClusterRuntime:
         ok = False
         arg_refs: List[tuple] = []
         args = kwargs = value = None
+        # Same worker-side split as _execute_task (see there).
+        attr_on = attribution.enabled
+        split = {"arg_resolve": 0, "exec": 0, "result_pack": 0}
+        _tmark = time.perf_counter() if attr_on else 0.0
         try:
             if task_id in self._cancelled_pending:
                 raise TaskCancelledError(task_id)
             self._ensure_job_env(spec.get("job_id"))
             args, kwargs, arg_refs = self._resolve_task_args(spec["args"])
+            if attr_on:
+                now = time.perf_counter()
+                split["arg_resolve"] = int((now - _tmark) * 1e6)
+                _tmark = now
             traced = tracing_enabled() or spec.get("trace_ctx")
             ctx = (span(f"actor.run {name}",
                         parent=spec.get("trace_ctx"),
@@ -3069,9 +3241,16 @@ class ClusterRuntime:
                     raise TaskCancelledError(task_id)
                 finally:
                     self._running_task_cfuts.pop(task_id, None)
+            if attr_on:
+                now = time.perf_counter()
+                split["exec"] = int((now - _tmark) * 1e6)
+                _tmark = now
             args = kwargs = None
             results = self._package_returns(task_id, num_returns, name,
                                             value)
+            if attr_on:
+                split["result_pack"] = int(
+                    (time.perf_counter() - _tmark) * 1e6)
             ok = True
         except BaseException as e:  # noqa: BLE001
             self._die_if_orphaned()
@@ -3088,6 +3267,8 @@ class ClusterRuntime:
                 job_id=spec.get("job_id"),
                 actor_id=spec.get("actor_id"))
             _reset_task_context(token)
+        if attr_on:
+            return {"results": results, "attr_exec": split}
         return {"results": results}
 
     async def handle_push_actor_task(self, conn: ServerConnection, *,
@@ -3110,17 +3291,15 @@ class ClusterRuntime:
         await self._await_actor_turn(spec)
         executor = (getattr(self, "_actor_group_executors", {}) or {}).get(
             spec.get("concurrency_group"))
-        if attr_on:
-            _t1 = time.perf_counter()
         fut = loop.run_in_executor(
             executor or self._actor_executor or self._exec_pool,
             self._execute_actor_method, spec)
         self._advance_actor_turn(spec)
         reply = await fut
         if attr_on:
-            reply["attr"] = {
-                "decode": decode_us,
-                "exec": int((time.perf_counter() - _t1) * 1e6)}
+            attr = {"decode": decode_us}
+            attr.update(reply.pop("attr_exec", None) or {})
+            reply["attr"] = attr
         return reply
 
     # Explicit per-caller sequencing (reference:
@@ -3247,6 +3426,14 @@ class ClusterRuntime:
             pool,
             lambda: deposit_remote(kind, channel, capacity, data, seq,
                                    ordered=ordered))
+
+    async def handle_collective_ranks(self, conn: ServerConnection) -> dict:
+        """{group: rank} of this process's p2p-capable collective groups
+        — the device-channel writer's route discovery (cgraph/channel.py
+        DeviceChannel._ensure_route)."""
+        from ray_tpu.util.collective import local_ranks
+
+        return local_ranks()
 
     async def handle_exit_worker(self, conn: ServerConnection) -> bool:
 

@@ -14,15 +14,61 @@ unreferenced sealed objects (reference: `eviction_policy.h`).
 from __future__ import annotations
 
 import logging
+import mmap
+import os
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from multiprocessing import shared_memory
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+try:
+    import _posixshmem   # CPython's shm_open binding (Linux/macOS)
+except ImportError:      # pragma: no cover - non-POSIX fallback
+    _posixshmem = None
 
 logger = logging.getLogger(__name__)
 
 SHM_PREFIX = "rtpu_"
+
+
+class _RawShm:
+    """Minimal attach to an existing POSIX shm segment: shm_open + mmap,
+    with NO resource_tracker registration.
+
+    `multiprocessing.shared_memory.SharedMemory` registers every attach
+    with the tracker daemon and our `_untrack` then unregisters it — two
+    tracker-pipe writes that cost ~0.5 ms each on virtualized kernels
+    and dominated the get-10MB p50 (round-7 copy audit). The raylet owns
+    segment lifetime, so a worker attach must be bookkeeping-free."""
+
+    __slots__ = ("name", "buf", "_mmap")
+
+    def __init__(self, name: str):
+        fd = _posixshmem.shm_open("/" + name, os.O_RDWR, mode=0)
+        try:
+            size = os.fstat(fd).st_size
+            self._mmap = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self.name = name
+        self.buf = memoryview(self._mmap)
+
+    def close(self) -> None:
+        if self.buf is not None:
+            self.buf.release()   # BufferError while views are alive
+            self.buf = None
+        self._mmap.close()       # BufferError while derived views live
+
+
+def attach_segment(name: str):
+    """Attach `name` for reading/writing with the cheapest available
+    mechanism (raw shm_open on POSIX; SharedMemory elsewhere)."""
+    if _posixshmem is not None:
+        return _RawShm(name)
+    shm = shared_memory.SharedMemory(name=name)
+    _untrack(shm)
+    return shm
 
 
 def shm_name_for(object_id_hex: str) -> str:
@@ -52,6 +98,9 @@ class LocalObjectStore:
         self.capacity = capacity_bytes
         self.used = 0
         self._objects: "OrderedDict[str, _Entry]" = OrderedDict()
+        # Segments unlinked while a read_view still aliased the mapping:
+        # retried on later deletes so their __del__ never squawks.
+        self._deferred_close: List[Any] = []
 
     # -- create/seal (reference: plasma store.cc ProcessCreateRequests) --
     def create(self, oid: str, size: int) -> str:
@@ -96,6 +145,23 @@ class LocalObjectStore:
         entry.shm.buf[: len(data)] = data
         self.seal(oid)
 
+    def create_from(self, oid: str, chunks) -> None:
+        """Buffer-protocol put: create+write+seal from a chunk list (any
+        bytes-like, including memoryviews over array buffers) with no
+        intermediate join — each chunk is copied exactly once, into the
+        segment."""
+        if self.contains(oid):
+            return
+        size = sum(len(c) for c in chunks)
+        self.create(oid, size)
+        entry = self._objects[oid]
+        off = 0
+        for c in chunks:
+            n = len(c)
+            entry.shm.buf[off:off + n] = c
+            off += n
+        self.seal(oid)
+
     # -- read ------------------------------------------------------------
     def contains(self, oid: str) -> bool:
         entry = self._objects.get(oid)
@@ -120,6 +186,20 @@ class LocalObjectStore:
         if entry is None or not entry.sealed:
             raise KeyError(f"object {oid[:8]} not present/sealed")
         return bytes(entry.shm.buf[: entry.size])
+
+    def read_view(self, oid: str) -> memoryview:
+        """Zero-copy view over a sealed object's segment.
+
+        Lifetime contract: the view aliases the live mapping. `delete`
+        (explicit or via eviction) unlinks the segment but the mapping —
+        and therefore an already-taken view — stays readable until the
+        last view dies (frozen-mapping guarantee); a read_view AFTER the
+        delete raises KeyError."""
+        entry = self._objects.get(oid)
+        if entry is None or not entry.sealed:
+            raise KeyError(f"object {oid[:8]} not present/sealed")
+        self._objects.move_to_end(oid)  # LRU touch
+        return entry.shm.buf[: entry.size]
 
     def read_range(self, oid: str, offset: int, length: int) -> bytes:
         """One transfer chunk (reference: object_manager chunked reads,
@@ -163,10 +243,27 @@ class LocalObjectStore:
             return False
         self.used -= entry.size
         try:
-            entry.shm.close()
             entry.shm.unlink()
         except FileNotFoundError:
             pass
+        try:
+            entry.shm.close()
+        except BufferError:
+            # A read_view is still alive: the unlinked mapping stays
+            # valid for that view (frozen-mapping guarantee); park the
+            # handle and retry once the view's holder drops it.
+            self._deferred_close.append(entry.shm)
+        except FileNotFoundError:
+            pass
+        if self._deferred_close:
+            parked, self._deferred_close = self._deferred_close, []
+            for shm in parked:
+                try:
+                    shm.close()
+                except BufferError:
+                    self._deferred_close.append(shm)
+                except Exception:
+                    pass
         return True
 
     def _ensure_space(self, size: int) -> None:
@@ -216,6 +313,8 @@ class NativeObjectStore:
             raise RuntimeError("native store library unavailable")
         self.capacity = capacity_bytes
         self._prefix = prefix
+        self._views: Dict[str, Any] = {}   # read_view attachments
+        self._deferred_views: List[Any] = []   # closes blocked by views
         self._h = self._lib.rts_open(
             prefix.encode(), (spill_dir or "").encode(), capacity_bytes)
         if not self._h:
@@ -262,6 +361,45 @@ class NativeObjectStore:
             return
         self.write_range(oid, 0, data)
         self.seal(oid)
+
+    def create_from(self, oid: str, chunks) -> None:
+        """Buffer-protocol put: chunks land in the segment via pwritev on
+        the tmpfs file (kernel copies straight from the source buffers —
+        no join, no per-page write faults)."""
+        if self.contains(oid):
+            return
+        size = sum(len(c) for c in chunks)
+        try:
+            name = self.create(oid, size)
+        except FileExistsError:
+            return
+        try:
+            fd = os.open(f"/dev/shm/{name}", os.O_RDWR)
+        except OSError:
+            off = 0
+            for c in chunks:
+                self.write_range(oid, off, bytes(c))
+                off += len(c)
+            self.seal(oid)
+            return
+        try:
+            _pwritev_chunks(fd, chunks)
+        finally:
+            os.close(fd)
+        self.seal(oid)
+
+    def read_view(self, oid: str) -> memoryview:
+        """Zero-copy view via a process-local attach of the segment (the
+        native store maps it in C; this side maps it again). Same
+        lifetime contract as LocalObjectStore.read_view."""
+        info = self.info(oid)
+        if info is None:
+            raise KeyError(f"object {oid[:8]} not present/sealed")
+        name, size = info
+        shm = self._views.get(name)
+        if shm is None:
+            shm = self._views[name] = attach_segment(name)
+        return shm.buf[:size]
 
     def contains(self, oid: str) -> bool:
         return bool(self._lib.rts_contains(self._h, oid.encode()))
@@ -316,7 +454,27 @@ class NativeObjectStore:
         self._lib.rts_unpin_worker(self._h, worker_id.encode())
 
     def delete(self, oid: str) -> bool:
-        return self._lib.rts_delete(self._h, oid.encode()) == 0
+        # Drop this object's read_view attachment with it — otherwise
+        # every object ever viewed pins its (unlinked) segment's pages
+        # until process shutdown. BufferError (a live view still
+        # aliases the mapping) parks the handle for retry on later
+        # deletes, mirroring LocalObjectStore's deferred close.
+        info = self.info(oid)
+        ok = self._lib.rts_delete(self._h, oid.encode()) == 0
+        if info is not None:
+            shm = self._views.pop(info[0], None)
+            if shm is not None:
+                self._deferred_views.append(shm)
+        if self._deferred_views:
+            parked, self._deferred_views = self._deferred_views, []
+            for shm in parked:
+                try:
+                    shm.close()
+                except BufferError:
+                    self._deferred_views.append(shm)
+                except Exception:
+                    pass
+        return ok
 
     def object_inventory(self) -> list:
         import ctypes
@@ -340,6 +498,12 @@ class NativeObjectStore:
                 "backend": "native"}
 
     def shutdown(self) -> None:
+        for shm in self._views.values():
+            try:
+                shm.close()
+            except Exception:
+                pass
+        self._views.clear()
         if self._h:
             self._lib.rts_shutdown(self._h)
             self._lib.rts_close(self._h)
@@ -381,17 +545,38 @@ def _untrack(shm: shared_memory.SharedMemory) -> None:
         pass
 
 
+def _pwritev_chunks(fd: int, chunks) -> None:
+    """Scatter-gather write of a chunk list at offset 0 of `fd`."""
+    iov = [memoryview(c) for c in chunks if len(c)]
+    off = 0
+    while iov:
+        # Kernel iovec limit: feed at most IOV_MAX (1024) chunks
+        # per call; the partial-write loop naturally resumes.
+        n = os.pwritev(fd, iov[:1024], off)
+        if n <= 0:
+            raise OSError("pwritev returned %d" % n)
+        off += n
+        # Drop fully-written chunks; split a partial one.
+        while iov and n >= len(iov[0]):
+            n -= len(iov[0])
+            iov.pop(0)
+        if iov and n:
+            iov[0] = iov[0][n:]
+
+
 class WorkerStoreClient:
     """Worker-side zero-copy access to the node store: control via raylet
     RPC (done by the caller), data via direct shm attach (reference:
-    plasma/client.h)."""
+    plasma/client.h). Attaches use raw shm_open+mmap (`attach_segment`),
+    never `SharedMemory` — the latter's resource-tracker registration
+    costs two tracker-pipe writes (~1 ms total on virtualized kernels)
+    per attach/release cycle, which dominated get-10MB before round 7."""
 
     def __init__(self):
-        self._attached: Dict[str, shared_memory.SharedMemory] = {}
+        self._attached: Dict[str, Any] = {}
 
     def write(self, shm_name: str, payload_writer) -> None:
-        shm = shared_memory.SharedMemory(name=shm_name)
-        _untrack(shm)
+        shm = attach_segment(shm_name)
         try:
             payload_writer(shm.buf)
         finally:
@@ -405,8 +590,6 @@ class WorkerStoreClient:
         pages); pwritev copies in the kernel with no user page-table
         faults — ~memcpy speed into a pool-prefaulted segment. One
         syscall, scatter-gather over the serialized chunks."""
-        import os
-
         try:
             fd = os.open(f"/dev/shm/{shm_name}", os.O_RDWR)
         except OSError:
@@ -414,21 +597,7 @@ class WorkerStoreClient:
             self.write(shm_name, lambda buf: _copy_chunks_into(buf, chunks))
             return
         try:
-            iov = [memoryview(c) for c in chunks if len(c)]
-            off = 0
-            while iov:
-                # Kernel iovec limit: feed at most IOV_MAX (1024) chunks
-                # per call; the partial-write loop naturally resumes.
-                n = os.pwritev(fd, iov[:1024], off)
-                if n <= 0:
-                    raise OSError("pwritev returned %d" % n)
-                off += n
-                # Drop fully-written chunks; split a partial one.
-                while iov and n >= len(iov[0]):
-                    n -= len(iov[0])
-                    iov.pop(0)
-                if iov and n:
-                    iov[0] = iov[0][n:]
+            _pwritev_chunks(fd, chunks)
         finally:
             os.close(fd)
 
@@ -437,8 +606,7 @@ class WorkerStoreClient:
         until `release` (the view must not outlive it)."""
         shm = self._attached.get(shm_name)
         if shm is None:
-            shm = shared_memory.SharedMemory(name=shm_name)
-            _untrack(shm)
+            shm = attach_segment(shm_name)
             self._attached[shm_name] = shm
         return shm.buf[:size]
 
@@ -452,10 +620,9 @@ class WorkerStoreClient:
         if shm_name in self._attached:
             return True
         try:
-            shm = shared_memory.SharedMemory(name=shm_name)
+            shm = attach_segment(shm_name)
         except (FileNotFoundError, OSError, ValueError):
             return False
-        _untrack(shm)
         self._attached[shm_name] = shm
         return True
 

@@ -9,6 +9,19 @@ Frame: [u32 little-endian length][msgpack body]
 Body (request):  {"i": req_id, "m": method, "a": args_dict}
 Body (response): {"i": req_id, "ok": bool, "r": result | "e": error_str}
 Body (push):     {"push": channel, "d": data}   (server -> client only)
+
+Blob frames (bulk data plane, e.g. array-channel pushes): embedding a
+multi-megabyte payload in the msgpack body costs one full copy at pack
+time and another at unpack. A call made with `_blob=` instead ships the
+payload OUT OF BAND, after the body, in the same frame:
+
+    [u32 (BLOB_BIT | total)][u32 body_len][body][raw blob bytes]
+
+The body carries `_bk`, the argument name the blob binds to; read_frame
+reads the blob into one dedicated buffer and attaches it to the decoded
+args untouched, so the receiver can build zero-copy views (np.frombuffer,
+dlpack) directly over the wire buffer. BLOB_BIT is bit 31 of the length
+word (MAX_FRAME < 2^29 keeps it unambiguous).
 """
 
 from __future__ import annotations
@@ -28,6 +41,7 @@ logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 512 * 1024 * 1024
+BLOB_BIT = 0x8000_0000
 
 
 def pack(obj: Any) -> bytes:
@@ -35,9 +49,42 @@ def pack(obj: Any) -> bytes:
     return _LEN.pack(len(body)) + body
 
 
+def pack_blob_frames(obj: Any, blob_key: str, chunks) -> list:
+    """A request frame whose bulk payload rides out of band: returns a
+    chunk list for the transport (never joined — a join IS the copy this
+    path exists to skip). `obj["a"][blob_key]` must be absent; the reader
+    re-attaches the blob under that name."""
+    body = msgpack.packb(dict(obj, _bk=blob_key), use_bin_type=True)
+    blob_len = sum(len(c) for c in chunks)
+    total = _LEN.size + len(body) + blob_len
+    if total > MAX_FRAME:
+        raise ConnectionError(f"frame too large: {total}")
+    return [_LEN.pack(BLOB_BIT | total) + _LEN.pack(len(body)) + body,
+            *chunks]
+
+
 async def read_frame(reader: asyncio.StreamReader) -> Any:
     header = await reader.readexactly(_LEN.size)
     (length,) = _LEN.unpack(header)
+    if length & BLOB_BIT:
+        length &= ~BLOB_BIT
+        if length > MAX_FRAME:
+            raise ConnectionError(f"frame too large: {length}")
+        (body_len,) = _LEN.unpack(await reader.readexactly(_LEN.size))
+        if body_len > length - _LEN.size:
+            # body_len is wire-supplied: bound it by the (already capped)
+            # total, or a corrupt peer could demand a multi-GiB read.
+            raise ConnectionError(
+                f"blob frame body_len {body_len} exceeds frame {length}")
+        body = await reader.readexactly(body_len)
+        # The blob lands in ONE dedicated buffer and is handed to the
+        # handler as-is: np.frombuffer/memoryview over it is zero-copy.
+        blob = await reader.readexactly(length - _LEN.size - body_len)
+        msg = msgpack.unpackb(body, raw=False)
+        bk = msg.pop("_bk", None)
+        if bk is not None:
+            msg.setdefault("a", {})[bk] = blob
+        return msg
     if length > MAX_FRAME:
         raise ConnectionError(f"frame too large: {length}")
     body = await reader.readexactly(length)
@@ -94,6 +141,20 @@ class _BatchedWriter:
         if not self._scheduled:
             self._scheduled = True
             self._loop.call_soon(self.flush)
+
+    def send_frames(self, chunks: list) -> None:
+        """Write one logical frame given as a chunk list (blob frames).
+
+        Bypasses coalescing — the payload is bulk by construction — but
+        drains any buffered frames first so ordering holds. Each chunk is
+        written separately: the transport keeps a reference, so a
+        multi-megabyte array buffer is never joined into a fresh bytes
+        object on the way out."""
+        self.flush()
+        for c in chunks:
+            self._write(c)
+        self._hot = True
+        self._loop.call_soon(self._cool)
 
     def _cool(self) -> None:
         self._hot = False
@@ -400,14 +461,24 @@ class RpcClient:
         self._push_handlers[channel] = handler
 
     async def call(self, method: str, timeout: Optional[float] = 60.0,
+                   _blob: Optional[list] = None, _blob_key: str = "data",
                    **args: Any) -> Any:
+        """One request/response round trip. `_blob` (a list of buffer
+        chunks) ships out of band after the msgpack body and re-attaches
+        at the receiver as args[_blob_key] — the bulk data plane path
+        (see module docstring)."""
         if not self.connected:
             raise ConnectionLost(f"not connected to {self.address}")
         self._next_id += 1
         req_id = self._next_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
-        self._batch.send(pack({"i": req_id, "m": method, "a": args}))
+        body = {"i": req_id, "m": method, "a": args}
+        if _blob is None:
+            self._batch.send(pack(body))
+        else:
+            self._batch.send_frames(
+                pack_blob_frames(body, _blob_key, _blob))
         await self._batch.drain_if_needed()
         if timeout is None:
             return await fut

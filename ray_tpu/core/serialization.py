@@ -8,6 +8,13 @@ store without an extra copy, and reads return views over shared memory.
 Wire format of a stored object:
     [u32 metadata_len][metadata bytes (msgpack)] [pickled payload] [buffers...]
 metadata = {"nbuf": n, "buf_offsets": [...], "buf_lens": [...], "err": bool}
+
+Array-native format (the zero-copy data plane): a bare contiguous
+ndarray skips pickle entirely — the metadata carries dtype/shape
+(`"nd": {"d": dtype_str, "s": shape}`), the payload is empty, and the
+single buffer IS the array. `deserialize` of such an object returns an
+np view over the store segment without ever invoking a pickler, so a
+`get` of a 10 MB tensor costs a header unpack and nothing else.
 """
 
 from __future__ import annotations
@@ -30,21 +37,25 @@ class SerializedObject:
     payload: bytes
     buffers: List[memoryview]
     is_error: bool = False
+    nd: Optional[dict] = None   # array-native: {"d": dtype_str, "s": shape}
 
     def total_size(self) -> int:
         return (
             _HEADER.size
             + len(self._metadata())
             + len(self.payload)
-            + sum(len(b) for b in self.buffers)
+            + sum(b.nbytes if isinstance(b, memoryview) else len(b)
+                  for b in self.buffers)
         )
 
     def _metadata(self) -> bytes:
-        lens = [len(b) for b in self.buffers]
-        return msgpack.packb(
-            {"nbuf": len(self.buffers), "buf_lens": lens,
-             "payload_len": len(self.payload), "err": self.is_error}
-        )
+        lens = [b.nbytes if isinstance(b, memoryview) else len(b)
+                for b in self.buffers]
+        meta = {"nbuf": len(self.buffers), "buf_lens": lens,
+                "payload_len": len(self.payload), "err": self.is_error}
+        if self.nd is not None:
+            meta["nd"] = self.nd
+        return msgpack.packb(meta)
 
     def to_bytes(self) -> bytes:
         out = bytearray()
@@ -62,15 +73,42 @@ class SerializedObject:
         return [_HEADER.pack(len(meta)) + meta, self.payload, *self.buffers]
 
 
+def is_plain_ndarray(value: Any) -> bool:
+    """True for arrays the array-native format can carry: exactly
+    np.ndarray (subclasses may carry state pickle must capture),
+    contiguous, and a fixed-size non-object dtype."""
+    import numpy as np
+
+    return (type(value) is np.ndarray and value.dtype.kind not in "OV"
+            and value.flags.c_contiguous)
+
+
+def serialize_array(value) -> SerializedObject:
+    """Array-native serialization: a dtype/shape header plus the raw
+    buffer — no pickler on either side, and the buffer is handed to the
+    store writer as a view (the single shm write is the only copy)."""
+    view = memoryview(value)
+    return SerializedObject(
+        payload=b"",
+        buffers=[view.cast("B")] if value.size else [],
+        nd={"d": value.dtype.str, "s": list(value.shape)})
+
+
 def serialize(value: Any, *,
               ref_serializer: Optional[Callable[[Any], None]] = None
               ) -> SerializedObject:
     """Serialize `value`; large contiguous buffers are captured out-of-band.
 
+    Bare contiguous ndarrays take the array-native path (no pickle at
+    all — see serialize_array); everything else goes through cloudpickle
+    with out-of-band buffers.
+
     `ref_serializer` is called on every ObjectRef contained in the value so the
     owner can run the borrowing protocol (reference:
     `reference_count.h` borrowed-refs / `serialization.py` object-ref hooks).
     """
+    if is_plain_ndarray(value):
+        return serialize_array(value)
     buffers: List[pickle.PickleBuffer] = []
 
     def buffer_callback(pb: pickle.PickleBuffer) -> bool:
@@ -130,15 +168,27 @@ def serialize_fast_into(value: Any, buf: bytearray) -> None:
             serialize(value).write_into(buf)
     elif (t is np.ndarray and value.dtype.kind not in "OV"
           and value.flags.c_contiguous):
-        head = msgpack.packb({"d": value.dtype.str, "s": list(value.shape)})
-        buf += b"A"
-        buf += _HEADER.pack(len(head))
-        buf += head
-        if value.size:   # cast("B") rejects zeros in shape/strides
-            buf += memoryview(value).cast("B")
+        for chunk in pack_array_chunks(value):
+            buf += chunk
     else:
         buf += b"P"
         serialize(value).write_into(buf)
+
+
+def pack_array_chunks(value) -> list:
+    """THE byte-level "A" wire form of a plain contiguous ndarray, as a
+    chunk list: `[b"A" + u32 head_len + msgpack{d,s}, raw buffer view]`.
+    Single source of truth — `serialize_fast_into` embeds these chunks
+    inline and `ArrayChannel._encode_chunks` ships them out of band as
+    a blob frame; `deserialize_fast`'s "A" branch decodes both. The
+    buffer chunk is a VIEW of `value` (zero-copy): callers that cannot
+    guarantee the array stays unmutated until the transport consumes it
+    must copy first."""
+    head = msgpack.packb({"d": value.dtype.str, "s": list(value.shape)})
+    chunks = [b"A" + _HEADER.pack(len(head)) + head]
+    if value.size:   # cast("B") rejects zeros in shape/strides
+        chunks.append(memoryview(value).cast("B"))
+    return chunks
 
 
 def serialize_fast(value: Any) -> bytes:
@@ -182,6 +232,27 @@ def deserialize(data, *,
     off = _HEADER.size
     meta = msgpack.unpackb(bytes(view[off:off + meta_len]))
     off += meta_len
+    nd = meta.get("nd")
+    if nd is not None:
+        # Array-native object: reconstruct a zero-copy view straight
+        # over the (possibly shm-backed) buffer — no pickler runs.
+        import numpy as np
+
+        from ray_tpu.core import attribution
+
+        if attribution.enabled:
+            attribution.count("get.nd_view")
+        blen = meta["buf_lens"][0] if meta["buf_lens"] else 0
+        arr = np.frombuffer(view[off:off + blen], dtype=np.dtype(nd["d"]))
+        arr = arr.reshape(nd["s"])
+        # The view aliases the LIVE store segment (mapped O_RDWR), which
+        # other readers — and the writer's kept mapping — share. A
+        # writable array here would let `get(ref)[0] = x` silently
+        # corrupt the stored object for everyone (plasma maps client
+        # reads read-only for the same reason).
+        if arr.flags.writeable:
+            arr.flags.writeable = False
+        return arr
     payload = view[off:off + meta["payload_len"]]
     off += meta["payload_len"]
     buffers = []

@@ -68,6 +68,18 @@ class InputNode(DAGNode):
 
     def __init__(self):
         super().__init__((), {})
+        self._channel_kind = "obj"
+
+    def with_channel(self, kind: str) -> "InputNode":
+        """Select the compiled-graph channel type for the driver->actor
+        input edges (same kinds as `ClassMethodNode.with_channel`).
+        Input edges always snapshot the value at write time — the driver
+        keeps owning `execute()`'s argument — so `"array"` here buys the
+        blob-framed transport and on-device landing, not a live view."""
+        if kind not in ("obj", "array", "device"):
+            raise ValueError(f"unknown channel kind {kind!r}")
+        self._channel_kind = kind
+        return self
 
     def __enter__(self):
         return self
@@ -134,8 +146,18 @@ class ClassMethodNode(DAGNode):
         """Select the compiled-graph channel type carrying THIS node's
         result (reference: `with_type_hint(TorchTensorType())`).
         `"array"` keeps jax arrays on device for co-located consumers
-        and re-lands host bytes on device across processes."""
-        if kind not in ("obj", "array"):
+        and re-lands host bytes on device across processes; `"device"`
+        additionally moves the tensor writer->reader via collective p2p
+        when both endpoints hold ranks in a shared
+        `util.collective` group (falling back to `"array"` semantics
+        otherwise).
+
+        Zero-copy contract: on `"array"`/`"device"` edges the producing
+        method hands its result off to the transport as a view — it
+        must return a fresh array each iteration and never mutate a
+        returned array afterwards. (Driver-side `execute()` inputs are
+        exempt: input edges snapshot the value at write time.)"""
+        if kind not in ("obj", "array", "device"):
             raise ValueError(f"unknown channel kind {kind!r}")
         self._channel_kind = kind
         return self

@@ -175,9 +175,9 @@ def _layer(x, lp, cfg: TransformerConfig, mesh, manual_sp, cos, sin,
     q = apply_rotary(q, cos, sin, pos)
     k = apply_rotary(k, cos, sin, pos)
     if mesh is not None and not manual_sp:
-        from jax.sharding import NamedSharding
-        qkv_spec = NamedSharding(mesh, P("dp", "sp", "tp", None))
-        q, k, v = (jax.lax.with_sharding_constraint(t, qkv_spec)
+        from ray_tpu.util.jax_compat import with_sharding_constraint
+        qkv_spec = P("dp", "sp", "tp", None)
+        q, k, v = (with_sharding_constraint(t, mesh, qkv_spec)
                    for t in (q, k, v))
     o = attention(q, k, v, causal=True, mesh=mesh, positions=positions,
                   manual_sp=manual_sp)
@@ -196,9 +196,8 @@ def _layer(x, lp, cfg: TransformerConfig, mesh, manual_sp, cos, sin,
         aux = jnp.zeros((), jnp.float32)
     x = x + ff
     if mesh is not None and not manual_sp:
-        from jax.sharding import NamedSharding
-        x = jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, P("dp", "sp", None)))
+        from ray_tpu.util.jax_compat import with_sharding_constraint
+        x = with_sharding_constraint(x, mesh, P("dp", "sp", None))
     return x, aux
 
 
@@ -209,9 +208,8 @@ def backbone(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     act = cfg.dtype
     x = jnp.take(params["embed"], tokens, axis=0).astype(act)
     if mesh is not None:
-        from jax.sharding import NamedSharding
-        x = jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, P("dp", "sp", None)))
+        from ray_tpu.util.jax_compat import with_sharding_constraint
+        x = with_sharding_constraint(x, mesh, P("dp", "sp", None))
     cos, sin = rotary_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
 
     def scan_body(carry, lp):

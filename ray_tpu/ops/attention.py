@@ -81,7 +81,9 @@ def ring_attention_manual(q, k, v, q_pos, *, axis_name: str = "sp",
     """Manual-collective ring attention body. Must run inside a shard_map
     where `axis_name` is a manual axis. q/k/v: local blocks [B, S_loc, H, D];
     q_pos: [S_loc] global positions of the local block."""
-    axis_size = jax.lax.axis_size(axis_name)
+    from ray_tpu.util.jax_compat import axis_size as _axis_size
+
+    axis_size = _axis_size(axis_name)
     b, s_loc, h, d = q.shape
     scale = d ** -0.5
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
@@ -122,7 +124,7 @@ def ring_attention(q, k, v, *, mesh, axis_name: str = "sp",
                    causal: bool = True, positions=None):
     """Sequence-parallel attention: shard_map manual over `axis_name` only;
     batch/head axes stay under the automatic (GSPMD) partitioner."""
-    from jax import shard_map
+    from ray_tpu.util.jax_compat import shard_map
 
     if positions is None:
         positions = jnp.arange(q.shape[1])

@@ -26,7 +26,9 @@ def _gpipe_body(stage_params, x, positions, consts, *, stage_fn,
     x: [B, S(loc), D] activations (batch global/auto over dp); positions:
     [S(loc)] global positions; consts: replicated loop-invariant arrays
     (e.g. rotary tables) passed through to stage_fn."""
-    n_stages = lax.axis_size(axis)
+    from ray_tpu.util.jax_compat import axis_size
+
+    n_stages = axis_size(axis)
     rank = lax.axis_index(axis)
     stage_p = jax.tree.map(lambda a: jnp.squeeze(a, 0), stage_params)
 
@@ -84,7 +86,7 @@ def gpipe(stage_fn: Callable, stage_params, x, positions, consts=(), *,
     {pp, sp} — inside, the sequence dim is the local sp block and attention
     must use `ring_attention_manual`.
     """
-    from jax import shard_map
+    from ray_tpu.util.jax_compat import shard_map
 
     manual = {pp_axis}
     sp_in_mesh = sp_axis in mesh.axis_names and mesh.shape[sp_axis] > 1

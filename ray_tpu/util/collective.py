@@ -33,7 +33,7 @@ __all__ = [
     "init_collective_group", "destroy_collective_group",
     "create_collective_group", "get_rank", "get_collective_group_size",
     "allreduce", "allgather", "reducescatter", "broadcast", "reduce",
-    "barrier", "send", "recv", "ReduceOp",
+    "barrier", "send", "recv", "local_ranks", "ReduceOp",
 ]
 
 
@@ -375,18 +375,63 @@ def barrier(group_name: str = "default") -> None:
     group.pg.barrier().wait()
 
 
-def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+def _wait_bounded(work, timeout: Optional[float], what: str) -> None:
+    """Wait for a p2p Work handle, bounded: an unbounded gloo wait on a
+    dead peer wedges the calling thread forever (no ConnectionLost fires
+    on this plane), so channel transports pass their own deadline."""
+    if timeout is None:
+        work.wait()
+        return
+    try:
+        ok = work.wait(datetime.timedelta(seconds=timeout))
+    except TypeError:        # backend Work without timeout support
+        work.wait()
+        return
+    except RuntimeError as e:
+        # gloo surfaces BOTH deadline expiry and transport failures as
+        # RuntimeError — only relabel the former; a connection reset
+        # from a dead peer must stay a connection error, not appear as
+        # a full deadline wait.
+        if "time" in str(e).lower():
+            raise TimeoutError(f"collective {what} timed out after "
+                               f"{timeout}s") from e
+        raise
+    if ok is False:
+        raise TimeoutError(f"collective {what} timed out after {timeout}s")
+
+
+def send(tensor, dst_rank: int, group_name: str = "default",
+         tag: int = 0, timeout: Optional[float] = None) -> None:
+    """P2P send to `dst_rank` (reference: collective.py send/recv pairs).
+    `tag` disambiguates concurrent streams between the same rank pair —
+    messages with one tag match in send order, so a FIFO edge (e.g. a
+    compiled-graph `"device"` channel) stays FIFO on the fabric. This is
+    the data plane the cgraph device transport rides: tensors move
+    writer->reader at fabric speed, never through the RPC byte plane.
+    `timeout` bounds the wait (a dead receiver otherwise parks this
+    thread in gloo forever)."""
     group = _require_gloo(group_name, "send")
     t, _ = _to_torch(tensor)
-    group.pg.send([t], dst_rank, 0).wait()
+    _wait_bounded(group.pg.send([t], dst_rank, tag), timeout, "send")
 
 
-def recv(tensor, src_rank: int, group_name: str = "default"):
-    """Receives into a tensor of the given shape/dtype; returns it."""
+def recv(tensor, src_rank: int, group_name: str = "default",
+         tag: int = 0, timeout: Optional[float] = None):
+    """Receives into a tensor of the given shape/dtype; returns it.
+    `timeout` bounds the wait (see send)."""
     group = _require_gloo(group_name, "recv")
     t, _ = _to_torch(tensor)
-    group.pg.recv([t], src_rank, 0).wait()
+    _wait_bounded(group.pg.recv([t], src_rank, tag), timeout, "recv")
     return _from_torch(t, tensor)
+
+
+def local_ranks() -> Dict[str, int]:
+    """{group_name: rank} for every p2p-capable group this process has
+    initialized. Served over the worker RPC plane (`collective_ranks`)
+    so a device-channel writer can discover its reader's rank without
+    any extra rendezvous machinery."""
+    return {name: g.rank for name, g in _GROUPS.items()
+            if g.backend == "gloo"}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,63 @@
+"""Version shims over jax APIs that moved between releases.
+
+`shard_map` graduated from `jax.experimental.shard_map` (kwargs
+`check_rep`/`auto`, manual over every mesh axis not listed in `auto`)
+to `jax.shard_map` (kwargs `check_vma`/`axis_names`, manual over
+exactly `axis_names`). Kernel code targets the new surface; this shim
+translates it for the older runtime when the top-level symbol is
+absent.
+"""
+
+from __future__ import annotations
+
+
+def axis_size(axis_name):
+    """`jax.lax.axis_size` (new) with a `psum(1, axis)` fallback — both
+    only valid under a manual mapped axis, same as the real thing."""
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def with_sharding_constraint(x, mesh, spec):
+    """`jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))`
+    that degrades to identity inside the full-manual fallback.
+
+    On old runtimes `shard_map` below is manual over EVERY mesh axis, so
+    an inner GSPMD hint referencing any of them raises. On new jax (and
+    anywhere outside a manual region) this is exactly the real thing."""
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        from jax._src import core as _core
+
+        bound = set(getattr(_core.get_axis_env(), "axis_sizes", ()))
+        if bound & set(mesh.axis_names):
+            return x
+    from jax.sharding import NamedSharding
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, spec))
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names,
+              check_vma: bool = True):
+    """`jax.shard_map`-shaped entry point: manual over `axis_names`,
+    automatic (GSPMD) over every other mesh axis."""
+    try:
+        from jax import shard_map as _sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+
+        # No `auto=` here: partial-auto shard_map hits an XLA CHECK
+        # failure (spmd_partitioner.cc IsManualSubgroup, SIGABRT) in
+        # jaxlib <= 0.4.36. Full manual instead — axes outside
+        # `axis_names` are unreferenced by the specs, so inputs are
+        # gathered/replicated over them: numerically identical, and
+        # only the old-runtime fallback pays the gather.
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               axis_names=axis_names, check_vma=check_vma)

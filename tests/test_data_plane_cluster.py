@@ -1,0 +1,288 @@
+"""Cluster-mode zero-copy data plane: sharded put/get of multi-device
+jax Arrays and the `"device"` compiled-graph channel transport.
+
+Reference coverage class: plasma object-manager tests (one store object
+per shard, no gathered copy) + `test_accelerated_dag.py` tensor-channel
+parity. CPU-only: conftest forces 8 virtual jax devices
+(`xla_force_host_platform_device_count`), so NamedSharding layouts run
+anywhere.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _sharded_array(shape=(8, 8), mesh_shape=(4, 2), axes=("x", "y")):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devs = jax.devices("cpu")[: mesh_shape[0] * mesh_shape[1]]
+    mesh = Mesh(np.array(devs).reshape(mesh_shape), axes)
+    sharding = NamedSharding(mesh, PartitionSpec(*axes))
+    host = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+    return jax.device_put(host, sharding), host, sharding
+
+
+# ---------------------------------------------------------------------------
+# sharded put/get
+# ---------------------------------------------------------------------------
+def test_sharded_put_one_object_per_shard(ray_cluster):
+    ray_tpu = ray_cluster
+    from ray_tpu.core.worker import current_runtime
+
+    arr, host, sharding = _sharded_array()
+    ref = ray_tpu.put(arr)
+    rt = current_runtime()
+    kids = rt._shard_children[ref.hex()]
+    # Exactly one store object per addressable shard, all distinct.
+    assert len(kids) == len(arr.sharding.device_set) == 8
+    assert len(set(kids)) == 8
+    # Every shard object is owned (pinned by the manifest) right now.
+    for oid in kids:
+        assert oid in rt._owned
+    back = ray_tpu.get(ref)
+    assert back.sharding == sharding
+    assert back.dtype == arr.dtype and back.shape == arr.shape
+    np.testing.assert_array_equal(np.asarray(back), host)
+    # Manifest release frees the shard objects with it.
+    del ref, back
+    import gc
+    gc.collect()
+    for oid in kids:
+        assert oid not in rt._owned
+
+
+def test_sharded_get_never_gathers_host_side(ray_cluster):
+    """The manifest path must reassemble per shard — `deserialize` of
+    the manifest object yields a ShardManifest (not a full array), and
+    each fetched shard buffer is shard-sized, not array-sized."""
+    ray_tpu = ray_cluster
+    from ray_tpu.util.device_arrays import ShardManifest
+
+    arr, host, _ = _sharded_array(shape=(16, 16))
+    ref = ray_tpu.put(arr)
+    from ray_tpu.core.worker import current_runtime
+
+    rt = current_runtime()
+    # Peek at the stored manifest object directly: it must be the
+    # manifest, NOT a pickled gathered array.
+    kind, payload = rt._owned[ref.hex()].fut.result()
+    raw = (rt._deserialize_payload(payload) if kind == "inline"
+           else rt._read_local_shm(rt._local_shm[ref.hex()]))
+    assert isinstance(raw, ShardManifest)
+    shard_nbytes = host.nbytes // 8
+    for oid in raw.shard_oids:
+        skind, spayload = rt._owned[oid].fut.result()
+        shard = (rt._deserialize_payload(spayload) if skind == "inline"
+                 else rt._read_local_shm(rt._local_shm[oid]))
+        assert shard.nbytes == shard_nbytes   # shard-sized, never full
+    back = ray_tpu.get(ref)
+    np.testing.assert_array_equal(np.asarray(back), host)
+    del ref
+
+
+def test_sharded_put_get_bfloat16(ray_cluster):
+    """Extension dtypes (the training dtype!) round-trip: shards are
+    stored as raw bytes and the manifest's dtype NAME is authoritative
+    (dtype.str of bfloat16 is '<V2', which np round-trips to raw
+    void)."""
+    ray_tpu = ray_cluster
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devs = jax.devices("cpu")[:8]
+    mesh = Mesh(np.array(devs).reshape(4, 2), ("x", "y"))
+    sharding = NamedSharding(mesh, PartitionSpec("x", "y"))
+    host = np.arange(64, dtype=np.float32).reshape(8, 8)
+    arr = jax.device_put(jnp.asarray(host, dtype=jnp.bfloat16), sharding)
+    ref = ray_tpu.put(arr)
+    back = ray_tpu.get(ref)
+    assert back.dtype == jnp.bfloat16
+    assert back.sharding == sharding
+    np.testing.assert_array_equal(
+        np.asarray(back.astype(jnp.float32)), host)
+    del ref
+
+
+def test_get_returns_read_only_view(ray_cluster):
+    """The zero-copy view aliases the live store segment shared with
+    every other reader: user mutation must be refused, not silently
+    corrupt the stored object."""
+    ray_tpu = ray_cluster
+    arr = np.arange(1 << 18, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    assert not out.flags.writeable
+    with pytest.raises((ValueError, RuntimeError)):
+        out[0] = 123.0
+    # And the stored object is intact for the next reader.
+    np.testing.assert_array_equal(ray_tpu.get(ref), arr)
+    del ref
+
+
+def test_sharded_ref_as_task_arg(ray_cluster):
+    """A worker receiving a sharded ref assembles it from the manifest
+    during arg resolution (same 8 CPU devices on a single node)."""
+    ray_tpu = ray_cluster
+
+    @ray_tpu.remote
+    def total(x):
+        import jax.numpy as jnp
+
+        return float(jnp.sum(x))
+
+    arr, host, _ = _sharded_array()
+    ref = ray_tpu.put(arr)
+    assert ray_tpu.get(total.remote(ref), timeout=120) == float(host.sum())
+    del ref
+
+
+# ---------------------------------------------------------------------------
+# "device" channel transport
+# ---------------------------------------------------------------------------
+class _Stage:
+    def __init__(self, rank=None, world=2, group="devchan"):
+        self.rank, self.world, self.group = rank, world, group
+
+    def join_group(self):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(self.world, self.rank, backend="gloo",
+                                  group_name=self.group)
+        return col.get_rank(self.group)
+
+    def leave_group(self):
+        from ray_tpu.util import collective as col
+
+        col.destroy_collective_group(self.group)
+        return True
+
+    def scale(self, x):
+        return np.asarray(x) * 2.0
+
+    def plus(self, x):
+        return np.asarray(x) + 1.0
+
+
+def _chain(ray_tpu, kind, a, b):
+    from ray_tpu.dag import InputNode
+
+    with InputNode() as inp:
+        dag = b.plus.bind(a.scale.bind(inp).with_channel(kind))
+    return dag.experimental_compile()
+
+
+def test_device_channel_parity_with_push(ray_cluster):
+    """The p2p transport must produce exactly what the push transport
+    produces — same chain, same inputs, `"device"` vs `"array"` edge —
+    with the payloads actually moving over collective send/recv."""
+    ray_tpu = ray_cluster
+    stage_cls = ray_tpu.remote(_Stage)
+    a, b = stage_cls.remote(rank=0), stage_cls.remote(rank=1)
+    ray_tpu.get([a.join_group.remote(), b.join_group.remote()],
+                timeout=120)
+    dev = _chain(ray_tpu, "device", a, b)
+    push = _chain(ray_tpu, "array", a, b)
+    try:
+        for i in range(4):
+            x = np.arange(64, dtype=np.float32).reshape(8, 8) + i
+            got = ray_tpu.get(dev.execute(x), timeout=120)
+            want = ray_tpu.get(push.execute(x), timeout=120)
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(want))
+            np.testing.assert_array_equal(np.asarray(got), x * 2.0 + 1.0)
+    finally:
+        dev.teardown()
+        push.teardown()
+        ray_tpu.get([a.leave_group.remote(), b.leave_group.remote()],
+                    timeout=60)
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)
+
+
+def test_execute_input_buffer_reuse_safe(ray_cluster):
+    """Driver-side input edges snapshot the value at write time: the
+    producer-side fresh-array contract does NOT extend to user-owned
+    `execute()` inputs, so reusing (mutating) the input buffer between
+    executes must never corrupt an in-flight frame."""
+    ray_tpu = ray_cluster
+    from ray_tpu.dag import InputNode
+
+    stage_cls = ray_tpu.remote(_Stage)
+    a, b = stage_cls.remote(), stage_cls.remote()
+    with InputNode() as inp:
+        dag = b.plus.bind(
+            a.scale.bind(inp.with_channel("array")).with_channel("array"))
+    compiled = dag.experimental_compile()
+    try:
+        x = np.zeros(1 << 14, dtype=np.float32)
+        refs = []
+        for i in range(4):
+            x[:] = float(i)          # same buffer, rewritten each round
+            refs.append(compiled.execute(x))
+        for i, ref in enumerate(refs):
+            out = np.asarray(ray_tpu.get(ref, timeout=120))
+            np.testing.assert_array_equal(
+                out, np.full(1 << 14, i * 2.0 + 1.0, dtype=np.float32))
+    finally:
+        compiled.teardown()
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)
+
+
+def test_device_channel_falls_back_without_group(ray_cluster):
+    """Endpoints with no collective ranks: the `"device"` edge must
+    transparently ride the ArrayChannel push transport."""
+    ray_tpu = ray_cluster
+    stage_cls = ray_tpu.remote(_Stage)
+    a, b = stage_cls.remote(), stage_cls.remote()
+    compiled = _chain(ray_tpu, "device", a, b)
+    try:
+        x = np.arange(16, dtype=np.float32)
+        got = ray_tpu.get(compiled.execute(x), timeout=120)
+        np.testing.assert_array_equal(np.asarray(got), x * 2.0 + 1.0)
+    finally:
+        compiled.teardown()
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)
+
+
+def test_device_channel_non_tensor_payload_falls_back(ray_cluster):
+    """Non-array payloads on a `"device"` edge ride the generic codec
+    (the route is for tensors only)."""
+    ray_tpu = ray_cluster
+
+    class _Dicty:
+        def wrap(self, x):
+            return {"v": list(np.asarray(x).ravel())}
+
+        def unwrap(self, d):
+            return sum(d["v"])
+
+    dicty_cls = ray_tpu.remote(_Dicty)
+    a, b = dicty_cls.remote(), dicty_cls.remote()
+    from ray_tpu.dag import InputNode
+
+    with InputNode() as inp:
+        dag = b.unwrap.bind(a.wrap.bind(inp).with_channel("device"))
+    compiled = dag.experimental_compile()
+    try:
+        out = ray_tpu.get(compiled.execute(np.ones(4, np.float32)),
+                          timeout=120)
+        assert out == 4.0
+    finally:
+        compiled.teardown()
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)

@@ -19,6 +19,15 @@ Round-6 floors sit at 75-80% of the LOW end of those fresh numbers
 round-5 regression (-40% tasks/s, would fold to ~380-510/s here) trips
 `tasks_per_s`, loose enough that 2-core scheduler noise does not.
 
+Round-7 data-plane calibration (same box, zero-copy put/get + blob-frame
+channels): get 10 MB p50 0.26-0.46 ms (22-41 GB/s as a view), put
+0.9-1.9 GB/s idle folding to ~0.3 under harness contention, array-chan
+pipeline 52-88 MB/s. The new `*_bw_MBps` floors and the tightened
+`get_10mb_ms` ceiling follow the same 75-80%-of-low-end rule, sized so
+one reintroduced 10 MB host copy (+2-3 ms on this box) trips them
+through fold-best noise (PROFILE.md round-7 table has the per-stage
+copy audit).
+
 Flake control: violations must survive the fold-best of ALL rounds — a
 real regression drags the best of every round down; one noisy round does
 not. The early exit means a healthy box usually pays 1-2 rounds.
@@ -42,15 +51,29 @@ FLOORS = {
     # box noise largely cancels.
     "cgraph_vs_dag_speedup": 3.0,
     "cgraph_calls_per_s": 250.0,
+    # Round-7 data-plane guards. get_bw is the zero-copy sentinel: the
+    # view path measures 22-41 GB/s (above memcpy speed — proof no copy
+    # runs); a reintroduced host-side copy of the 10 MB buffer drags it
+    # under ~3 GB/s, far below this floor. put does exactly one pwritev
+    # copy (idle 0.9-1.9 GB/s; harness-contended runs fold to ~0.3).
+    "get_bw_MBps": 10000.0,
+    "put_bw_MBps": 250.0,
+    # 2-stage compiled chain moving 4 MB tensors over "array" edges
+    # (blob frames, zero-copy landing): idle 52-88 MB/s end to end; a
+    # return to msgpack-embedded payloads (two extra full copies +
+    # join) halves it even through box noise.
+    "array_chan_MBps": 18.0,
 }
 CEILINGS = {
     "task_roundtrip_p50_ms": 5.5,
     "actor_call_p50_ms": 5.0,
     "put_10mb_ms": 22.0,
-    # Node-local gets bypass the raylet round trip entirely (round-6
-    # fast path); the ceiling is now set from sub-3 ms measurements
-    # where round 5 tolerated 15.
-    "get_10mb_ms": 4.0,
+    # Round 7: node-local gets of a just-put object reuse the WRITER's
+    # segment mapping (no shm_open/mmap on the read path) and land as
+    # an np view with no pickler — fresh p50s 0.26-0.46 ms where round
+    # 6 measured 0.56-0.79. Ceiling at ~4x the high end: a copy
+    # reintroduction (+2-3 ms for 10 MB on this box) trips it.
+    "get_10mb_ms": 2.0,
     "cgraph_call_ms": 4.5,
 }
 

@@ -1,0 +1,241 @@
+"""Fast unit tier: the zero-copy array data plane (no cluster).
+
+Pins the round-7 contracts:
+
+- array-native serialization (`serialization.serialize_array` / the nd
+  metadata path) is golden-equal to the pickled path for array values
+  and never invokes a pickler on decode;
+- `LocalObjectStore.create_from` / `read_view` — buffer-protocol put and
+  zero-copy read with the frozen-mapping lifetime rule (a taken view
+  survives delete/eviction; a read_view AFTER delete raises);
+- RPC blob frames: a bulk payload rides out of band and re-attaches at
+  the receiver as one dedicated buffer;
+- `ArrayChannel` codec: chunked encode round-trips, and an
+  already-decoded payload (device array deposited in-process) is never
+  round-tripped through host bytes again.
+"""
+
+import asyncio
+import pickle
+
+import numpy as np
+import pytest
+
+from ray_tpu.core import serialization as S
+from ray_tpu.core.object_store import LocalObjectStore
+
+pytestmark = pytest.mark.unit
+
+OID_A = "a" * 56
+OID_B = "b" * 56
+
+
+# ---------------------------------------------------------------------------
+# array-native serialization
+# ---------------------------------------------------------------------------
+def test_array_native_golden_equal_to_pickled_path():
+    arr = np.arange(4096, dtype=np.float32).reshape(64, 64)
+    via_nd = S.deserialize(S.serialize(arr).to_bytes())
+    # Wrapping in a list forces the generic cloudpickle path.
+    via_pickle = S.deserialize(S.serialize([arr]).to_bytes())[0]
+    assert via_nd.dtype == via_pickle.dtype == arr.dtype
+    assert via_nd.shape == via_pickle.shape == arr.shape
+    np.testing.assert_array_equal(via_nd, via_pickle)
+    np.testing.assert_array_equal(via_nd, arr)
+
+
+def test_array_native_skips_pickle_entirely(monkeypatch):
+    arr = np.arange(100, dtype=np.int64)
+    blob = S.serialize(arr).to_bytes()
+
+    def boom(*a, **k):
+        raise AssertionError("pickler invoked on the nd path")
+
+    monkeypatch.setattr(pickle, "loads", boom)
+    out = S.deserialize(blob)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_array_native_is_zero_copy_view():
+    arr = np.arange(1000, dtype=np.float32)
+    blob = bytearray(S.serialize(arr).to_bytes())
+    out = S.deserialize(blob)
+    assert out.base is not None            # a view, not a copy
+    # Mutating the backing buffer is visible through the view: proof
+    # the array aliases the wire/store buffer.
+    view = S.deserialize(blob)
+    blob[-4:] = np.float32(123.0).tobytes()
+    assert view[-1] == 123.0
+
+
+def test_non_plain_arrays_fall_back_to_pickle():
+    # Fortran-ordered, object-dtype, and subclass arrays must take the
+    # generic path (their invariants need a real pickler).
+    f = np.asfortranarray(np.arange(12).reshape(3, 4))
+    out = S.deserialize(S.serialize(f).to_bytes())
+    np.testing.assert_array_equal(out, f)
+    o = np.array([{"k": 1}, None], dtype=object)
+    out = S.deserialize(S.serialize(o).to_bytes())
+    assert out[0] == {"k": 1}
+    assert S.serialize(f).nd is None and S.serialize(o).nd is None
+
+
+def test_empty_and_scalar_shapes_roundtrip():
+    for arr in (np.empty((0, 5), np.float64), np.array(3.5),
+                np.zeros((1,), np.uint8)):
+        out = S.deserialize(S.serialize(arr).to_bytes())
+        assert out.dtype == arr.dtype and out.shape == arr.shape
+        np.testing.assert_array_equal(out, arr)
+
+
+# ---------------------------------------------------------------------------
+# store: create_from / read_view lifetime
+# ---------------------------------------------------------------------------
+def test_create_from_and_read_view_roundtrip():
+    st = LocalObjectStore(1 << 22)
+    arr = np.arange(512, dtype=np.float32)
+    st.create_from(OID_A, S.serialize(arr).chunks())
+    out = S.deserialize(st.read_view(OID_A))
+    np.testing.assert_array_equal(out, arr)
+    assert st.contains(OID_A)
+    del out
+    st.shutdown()
+
+
+def test_read_view_lifetime_across_delete():
+    st = LocalObjectStore(1 << 22)
+    arr = np.arange(256, dtype=np.int32)
+    st.create_from(OID_A, S.serialize(arr).chunks())
+    view = st.read_view(OID_A)
+    held = S.deserialize(view)          # zero-copy array over the view
+    assert st.delete(OID_A)
+    # Frozen-mapping guarantee: the already-taken view stays readable.
+    np.testing.assert_array_equal(held, arr)
+    # But the object is gone: a NEW read_view must fail.
+    with pytest.raises(KeyError):
+        st.read_view(OID_A)
+    del held, view
+    st.shutdown()
+
+
+def test_read_view_invalidated_by_eviction():
+    st = LocalObjectStore(2048)
+    st.create_from(OID_A, [b"x" * 1500])
+    assert st.read_view(OID_A).nbytes == 1500
+    # A second object that cannot fit evicts the first (LRU, unpinned).
+    st.create_from(OID_B, [b"y" * 1500])
+    with pytest.raises(KeyError):
+        st.read_view(OID_A)
+    assert bytes(st.read_view(OID_B)[:1]) == b"y"
+    st.shutdown()
+
+
+def test_create_from_multi_chunk_layout_matches_bytes_put():
+    st = LocalObjectStore(1 << 22)
+    chunks = [b"header", b"", b"payload", memoryview(b"tail")]
+    st.create_from(OID_A, chunks)
+    st.put_bytes(OID_B, b"headerpayloadtail")
+    assert bytes(st.read_view(OID_A)) == st.read_bytes(OID_B)
+    st.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# rpc blob frames
+# ---------------------------------------------------------------------------
+def test_blob_frame_roundtrip_attaches_payload():
+    from ray_tpu.core.rpc import pack, pack_blob_frames, read_frame
+
+    payload = np.arange(1 << 14, dtype=np.float64)
+    frames = pack_blob_frames(
+        {"i": 7, "m": "cgraph_push", "a": {"channel": "c1", "seq": 3}},
+        "data", [memoryview(payload).cast("B")])
+
+    async def main():
+        reader = asyncio.StreamReader()
+        for f in frames:
+            reader.feed_data(bytes(f))
+        # A normal frame following the blob frame must still parse.
+        reader.feed_data(pack({"i": 8, "m": "ping", "a": {}}))
+        reader.feed_eof()
+        first = await read_frame(reader)
+        second = await read_frame(reader)
+        return first, second
+
+    msg, nxt = asyncio.run(main())
+    assert msg["m"] == "cgraph_push" and msg["a"]["seq"] == 3
+    got = np.frombuffer(msg["a"]["data"], dtype=np.float64)
+    np.testing.assert_array_equal(got, payload)
+    assert nxt["m"] == "ping"
+
+
+# ---------------------------------------------------------------------------
+# ArrayChannel codec
+# ---------------------------------------------------------------------------
+def _concat(chunks) -> bytes:
+    return b"".join(bytes(c) for c in chunks)
+
+
+def test_array_channel_chunked_encode_roundtrip():
+    from ray_tpu.cgraph.channel import ArrayChannel
+
+    ch = ArrayChannel.__new__(ArrayChannel)
+    ch._init("t1", 2, None)
+    arr = np.arange(64, dtype=np.float32).reshape(8, 8)
+    out = ch._decode(_concat(ch._encode_chunks(arr)))
+    np.testing.assert_array_equal(np.asarray(out), arr)
+    # Non-tensor payloads ride the generic codec untouched.
+    assert ch._decode(_concat(ch._encode_chunks({"k": [1, 2]}))) == {
+        "k": [1, 2]}
+
+
+def test_array_channel_snapshot_writes_copies_buffer():
+    """Driver-written edges (`_snapshot_writes`, set by the compiler on
+    input channels) frame a PRIVATE copy — the caller keeps owning the
+    value and may mutate it after write() returns. Intermediate edges
+    stay zero-copy views under the fresh-array-per-iteration contract."""
+    from ray_tpu.cgraph.channel import ArrayChannel
+
+    ch = ArrayChannel.__new__(ArrayChannel)
+    ch._init("t4", 2, None)
+    arr = np.arange(16, dtype=np.float32)
+    view_chunks = ch._encode_chunks(arr)
+    assert view_chunks[1].obj is arr   # default: live view, zero-copy
+    ch._snapshot_writes = True
+    snap_chunks = ch._encode_chunks(arr)
+    assert snap_chunks[1].obj is not arr
+    arr[:] = -1.0   # mutate after "write": frame must be unaffected
+    out = ch._decode(_concat(snap_chunks))
+    np.testing.assert_array_equal(
+        np.asarray(out), np.arange(16, dtype=np.float32))
+
+
+def test_array_channel_decode_skips_already_landed_payload():
+    """The double-landing fix: a payload that is already a (device)
+    array — e.g. deposited in-process by the device transport — must
+    pass through _decode untouched, never re-encoded through host
+    bytes."""
+    import jax.numpy as jnp
+
+    from ray_tpu.cgraph.channel import ArrayChannel
+
+    ch = ArrayChannel.__new__(ArrayChannel)
+    ch._init("t2", 2, None)
+    dev = jnp.arange(8.0)
+    assert ch._decode(dev) is dev
+    host = np.arange(4.0)
+    assert ch._decode(host) is host
+
+
+def test_array_channel_local_handoff_preserves_identity():
+    import jax.numpy as jnp
+
+    from ray_tpu.cgraph.channel import ArrayChannel, unregister
+
+    ch = ArrayChannel(capacity=2, reader_addr=None, channel_id="t3")
+    try:
+        dev = jnp.arange(6.0)
+        ch.write(dev)
+        assert ch.read(timeout=1) is dev   # by reference, zero copies
+    finally:
+        ch.close()
+        unregister("t3")
