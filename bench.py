@@ -77,11 +77,13 @@ def main() -> None:
     # the compiled-graph micro-bench — a 3-actor chain via
     # experimental_compile().execute() vs the same chain through
     # dag.execute()'s per-task path (`cgraph_call_ms`,
-    # `dag_chain_call_ms`, `cgraph_vs_dag_speedup`) — and, via
-    # --attribute, the submit-path attribution breakdown (encode / lease
-    # / frame write / push rtt / worker decode+exec) so every BENCH_r*
-    # records where the task-plane time went, not just how much there
-    # was.
+    # `dag_chain_call_ms`, `cgraph_vs_dag_speedup`) — the round-8
+    # task-plane trajectory (`tasks_inline_per_s` next to `tasks_per_s`:
+    # the inline-vs-remote dispatch tiers) and, via --attribute, the
+    # submit-path attribution breakdown (encode / lease / frame write /
+    # push rtt / worker decode+exec, plus `submit.inline`/`submit.remote`
+    # and `lease.batch_size`) so every BENCH_r* records where the
+    # task-plane time went, not just how much there was.
     notes = {}
     try:
         import os

@@ -35,6 +35,15 @@ Labels in the submit-path breakdown (see `python -m ray_tpu.perf
 - ``get.local_shm``     node-local shm reads that bypassed the raylet
 - ``get.pull_rpc``      gets that did take the raylet pull_object RPC
 
+Round-8 task-plane labels: ``submit.inline`` / ``submit.remote`` count
+the dispatch split (inline executions vs leased pushes);
+``inline.arg_resolve`` / ``inline.exec`` / ``inline.result_pack`` are
+the caller-thread analogue of the worker split; ``lease.batch_size`` is
+a dimensionless distribution (``value()``: count = batched lease RPCs,
+mean/max = grants per RPC); ``ring.enq`` / ``ring.deq`` /
+``ring.doorbell`` / ``ring.fallback`` count submission-ring traffic
+(fallback = specs the ring could not carry that took the RPC path).
+
 Data-plane counters (round 7, the zero-copy audit — counts, not
 durations): ``get.nd_view`` array gets served as a zero-copy view over
 the store segment (no pickler ran); ``put.sharded``/``get.sharded``
@@ -104,12 +113,31 @@ def count(label: str, n: int = 1) -> None:
     s[0] += n
 
 
+_value_labels: set = set()
+
+
+def value(label: str, v: float) -> None:
+    """Fold a dimensionless sample (e.g. a lease batch size) into
+    `label`: snapshot reports mean/max in the sample's own units
+    instead of microseconds."""
+    _value_labels.add(label)
+    record(label, v)
+
+
 def snapshot() -> Dict[str, Dict[str, float]]:
     """{label: {count, total_ms, mean_us, max_us}} for reporting."""
     out = {}
     with _lock:
         items = [(k, list(v)) for k, v in _stats.items()]
     for label, (n, total, mx) in sorted(items):
+        if label in _value_labels:
+            out[label] = {
+                "count": n,
+                "total": round(total, 3),
+                "mean": round(total / n, 2) if n else 0.0,
+                "max": round(mx, 2),
+            }
+            continue
         out[label] = {
             "count": n,
             "total_ms": round(total * 1e3, 3),

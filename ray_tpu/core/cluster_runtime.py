@@ -146,8 +146,13 @@ class _LeasePool:
 
     def __init__(self):
         self.idle: List[dict] = []
-        self.inflight_leases = 0        # lease RPCs in flight to raylets
+        # Grants expected from in-flight lease RPCs (a batched request
+        # counts for its whole `count`); the RPC count itself is
+        # bounded separately by MAX_INFLIGHT via inflight_rpcs.
+        self.inflight_leases = 0
+        self.inflight_rpcs = 0          # lease RPCs in flight to raylets
         self.waiters: List[Any] = []    # futures of queued acquires
+        self.pump_scheduled = False     # a coalesced pump is queued
         self._max_inflight: Optional[int] = None
 
 
@@ -219,6 +224,25 @@ class ClusterRuntime:
         cfg = ray_config()
         self._pipeline_depth = cfg.worker_pipeline_depth
         self._pipeline_svc_threshold = cfg.pipeline_service_threshold_s
+        # Round-8 task-plane fast paths, each independently guarded:
+        # same-process inline execution (cost-model gated), batched
+        # lease grants, and the shm submission ring (see core/ring.py).
+        self._inline_enabled = cfg.task_inline_execution
+        self._inline_threshold_s = cfg.task_inline_threshold_ms / 1000.0
+        self._lease_batching = cfg.lease_batching
+        self._lease_batch_max = max(1, cfg.lease_batch_max)
+        self._ring_enabled = cfg.submit_ring
+        self._ring_slots = cfg.submit_ring_slots
+        self._ring_slot_bytes = cfg.submit_ring_slot_bytes
+        # Per-function exec-time EMA (seconds), fed by exec_us riding
+        # every task reply and by inline runs; the inline gate admits
+        # only functions whose EMA is KNOWN and below the threshold, so
+        # a long or blocking task is never inlined on spec.
+        self._fn_cost: Dict[str, float] = {}
+        # Submission-ring state: None = not set up, False = setup
+        # failed (RPC path permanently), dict = live.
+        self._ring: Any = None
+        self._ring_waiters: Dict[str, Any] = {}
         # Every granted task lease, until returned — the lease watchdog
         # sweeps this for orphans (see _lease_watchdog).
         self._live_leases: List[dict] = []
@@ -532,6 +556,7 @@ class ClusterRuntime:
             self._loop.run(self._server.stop(), timeout=2)
         except Exception:
             pass
+        self._close_submit_ring()
         self._shm.close()
         self._exec_pool.shutdown(wait=False, cancel_futures=True)
         pool = getattr(self, "_cgraph_deposit_pool", None)
@@ -1121,6 +1146,20 @@ class ClusterRuntime:
         if single or len(ref_list) == 1:
             value = self._fetch(ref_list[0], timeout)
             return value if single else [value]
+        # All-resolved fast path: a batched get over refs that are all
+        # locally landed (the shape every inline burst produces) reads
+        # on the caller thread — no event-loop round trip, no gather of
+        # N no-op coroutines. ANY miss falls back to the concurrent
+        # resolve below.
+        values: List[Any] = []
+        for ref in ref_list:
+            got = self._read_resolved_local(ref.hex())
+            if got is _MISS:
+                values = None
+                break
+            values.append(got)
+        if values is not None:
+            return self._assemble_all(values, timeout)
 
         async def _resolve_all():
             # Concurrent: N remote objects cost one round-trip latency,
@@ -1201,8 +1240,14 @@ class ClusterRuntime:
     # ==================================================================
     def submit_task(self, remote_function, opts, args, kwargs):
         _t0 = time.perf_counter() if attribution.enabled else 0.0
-        task_id = TaskID.for_task(self.job_id)
         fn_key = self._fn.export(remote_function._function)
+        if (self._inline_enabled
+                and self._inline_eligible(fn_key, opts, args, kwargs)):
+            return self._submit_inline(remote_function, fn_key, opts,
+                                       args, kwargs)
+        if attribution.enabled:
+            attribution.count("submit.remote")
+        task_id = TaskID.for_task(self.job_id)
         streaming = opts.num_returns in ("streaming", "dynamic")
         num_returns = 1 if streaming else opts.num_returns
         args_blob, pinned = self._serialize_args(args, kwargs)
@@ -1213,7 +1258,7 @@ class ClusterRuntime:
         # roots. Unsampled propagation is near-free since span() takes
         # the PRNG fast path for it (util/tracing.py).
         trace_ctx = current_traceparent() if tracing_enabled() else None
-        spec, sched_key = self._encode_task_spec(
+        spec, sched_key, tmpl = self._encode_task_spec(
             remote_function, opts, fn_key, num_returns, streaming,
             task_id=task_id.hex(), args=args_blob,
             # TOP-LEVEL arg refs only, for pre-lease dependency
@@ -1248,18 +1293,160 @@ class ClusterRuntime:
                 self._lineage[r.hex()] = rec
         self._enqueue_submit(
             ("task", spec, refs, pinned if not retain else None,
-             sched_key))
+             sched_key, tmpl))
         if streaming:
             return gen
         if opts.num_returns == 0:
             return None
         return refs[0] if opts.num_returns == 1 else refs
 
+    # -- same-process inline fast path (round 8) -----------------------
+    def _inline_eligible(self, fn_key: str, opts, args, kwargs) -> bool:
+        """Per-task dynamic inline decision (reference: the local-mode
+        short circuit, promoted to a cost-model gate). True only when
+        the scheduler would co-locate the task anyway AND it is known
+        to be tiny:
+
+        - exec-time EMA for this function is KNOWN and below the
+          threshold (first calls always go remote and report exec_us in
+          their replies — a long or blocking task is never inlined on
+          spec);
+        - pure-default demand (1 CPU, nothing else): any explicit
+          resource/env/placement request means the user asked for a
+          scheduling decision, which inlining would bypass;
+        - every top-level ObjectRef arg is locally resolved (owned,
+          value landed) — anything else needs IO the worker path
+          overlaps with other tasks;
+        - not streaming (generators hold the caller arbitrarily long).
+
+        `.options(_metadata={"inline": False})` opts a call site out
+        (perf.py uses it to keep measuring the remote plane).
+        """
+        ema = self._fn_cost.get(fn_key)
+        if ema is None or ema > self._inline_threshold_s:
+            return False
+        if opts.num_returns in ("streaming", "dynamic"):
+            return False
+        if (opts.num_cpus != 1.0 or opts.num_gpus or opts.resources
+                or opts.memory or opts.runtime_env
+                or opts.placement_group is not None
+                or opts.scheduling_strategy is not None
+                or opts.accelerator_type):
+            return False
+        md = opts._metadata
+        if md is not None and md.get("inline") is False:
+            return False
+        for a in args:
+            if isinstance(a, ObjectRef) and not self._resolved_locally(a):
+                return False
+        for a in kwargs.values():
+            if isinstance(a, ObjectRef) and not self._resolved_locally(a):
+                return False
+        return True
+
+    def _resolved_locally(self, ref: ObjectRef) -> bool:
+        """True only when the arg's VALUE is readable on this node with
+        no IO: an inline payload, or a node-local shm segment we wrote.
+        A done future whose copy lives on a REMOTE node is not enough —
+        inlining would turn .remote() into a blocking cross-node pull
+        on the caller thread."""
+        oid = ref.hex()
+        with self._owned_lock:
+            entry = self._owned.get(oid)
+        if entry is None or not entry.fut.done():
+            return False
+        kind, _payload = entry.fut.result()
+        if kind == "inline":
+            return True
+        # Stored object: local only if this process holds the segment
+        # (liveness re-checked by try_attach at read time; a rare
+        # eviction just makes the inline run pull like a worker would).
+        return oid in self._local_shm
+
+    def _update_fn_cost(self, fn_key: str, dt: float) -> None:
+        prev = self._fn_cost.get(fn_key)
+        self._fn_cost[fn_key] = (dt if prev is None
+                                 else 0.7 * prev + 0.3 * dt)
+        if len(self._fn_cost) > 4096:
+            self._fn_cost.clear()  # bounded, simple (re-learns)
+
+    def _submit_inline(self, remote_function, fn_key: str, opts,
+                       args, kwargs):
+        """Execute an inline-eligible task on the caller thread through
+        the SAME `_execute_task` the worker runs: task_events and the
+        execution span are emitted exactly once, exceptions take the
+        identical typed packaging (`_package_error` → RayTaskError
+        surfacing at `get`), and results land as real owned ObjectRefs
+        — already resolved, no lease, no push, no store round trip for
+        inline-sized values."""
+        task_id = TaskID.for_task(self.job_id)
+        num_returns = opts.num_returns
+        args_blob, pinned = self._serialize_args(args, kwargs)
+        trace_ctx = current_traceparent() if tracing_enabled() else None
+        spec = {
+            "task_id": task_id.hex(),
+            "job_id": self.job_id.hex(),
+            "name": remote_function._function_name,
+            "fn_key": fn_key,
+            "args": args_blob,
+            "num_returns": num_returns,
+            "trace_ctx": trace_ctx,
+        }
+        refs = self._make_return_refs(task_id, num_returns)
+        self._record_task_event(task_id.hex(), spec["name"], "SUBMITTED")
+        if attribution.enabled:
+            attribution.count("submit.inline")
+        reply = self._execute_task(spec)
+        # Feed the cost model from exec_us (user-code wall time), the
+        # same signal remote replies carry — NOT the full inline wall
+        # time, whose first run carries one-time costs (job-env fetch,
+        # import warmup) that would evict a genuinely tiny function
+        # from the inline tier for the next ~7 calls.
+        exec_us = reply.get("exec_us")
+        if exec_us is not None:
+            self._update_fn_cost(fn_key, exec_us / 1e6)
+        if attribution.enabled:
+            split = reply.pop("attr_exec", None)
+            if split:
+                # The caller-thread analogue of the worker split — NOT
+                # folded under worker.* so the --attribute table keeps
+                # the inline-vs-remote budget separable.
+                attribution.fold(split, prefix="inline.")
+        else:
+            reply.pop("attr_exec", None)
+        self._record_task_reply(spec, reply)
+        # Lineage parity: inline results that were large enough to be
+        # sealed into the node store are as losable as remote ones —
+        # retain the (lazily wire-encoded) spec for reconstruction and
+        # keep the arg pins alive with it, exactly like submit_task's
+        # retain branch. Purely-inline results live in the owner future
+        # and cannot be lost, so they skip the bookkeeping.
+        stored = any(r.get("node") for r in reply.get("results", ()))
+        if stored and opts.max_retries > 0 and num_returns != 0:
+            wire_spec, _, _ = self._encode_task_spec(
+                remote_function, opts, fn_key, num_returns, False,
+                task_id=task_id.hex(), args=args_blob,
+                arg_oids=[a.hex() for a in
+                          list(args) + list(kwargs.values())
+                          if isinstance(a, ObjectRef)],
+                trace_ctx=trace_ctx)
+            rec = {"spec": wire_spec,
+                   "ref_oids": [r.hex() for r in refs],
+                   "pinned": pinned, "left": max(opts.max_retries, 0),
+                   "live": len(refs), "inflight": False}
+            for r in refs:
+                self._lineage[r.hex()] = rec
+        else:
+            self._unpin_args(pinned)
+        if num_returns == 0:
+            return None
+        return refs[0] if num_returns == 1 else refs
+
     def _encode_task_spec(self, remote_function, opts, fn_key: str,
                           num_returns: int, streaming: bool, *,
                           task_id: str, args: bytes, arg_oids: list,
                           trace_ctx: Optional[str]
-                          ) -> Tuple[dict, str]:
+                          ) -> Tuple[dict, str, Optional[SpecTemplate]]:
         """Wire dict + lease scheduling key for one task submission.
 
         Template-spec encoding (reference: the TaskSpec invariants
@@ -1318,7 +1505,12 @@ class ClusterRuntime:
         tmpl, sched_key = hit
         return (tmpl.encode(task_id=task_id, args=args,
                             arg_oids=arg_oids, trace_ctx=trace_ctx),
-                sched_key)
+                sched_key,
+                # The template is handed down the submit path only when
+                # it is CACHED (stable identity): the submission ring
+                # registers it with the raylet once and then ships
+                # per-call deltas against it.
+                tmpl if cacheable else None)
 
     @staticmethod
     def _sched_key_of(spec) -> str:
@@ -1358,17 +1550,31 @@ class ClusterRuntime:
                 raise  # loop closed: surface at the submit call site
 
     def _drain_submits(self) -> None:
-        self._submit_drain_scheduled = False
-        while self._pending_submits:
-            item = self._pending_submits.popleft()
-            if item[0] == "task":
-                _, spec, refs, pinned, sched_key = item
-                asyncio.ensure_future(self._submit_async(
-                    spec, refs, pinned, sched_key=sched_key))
-            else:
-                _, spec, refs, pinned = item
-                asyncio.ensure_future(
-                    self._submit_actor_async(spec, refs, pinned))
+        while True:
+            while self._pending_submits:
+                item = self._pending_submits.popleft()
+                if item[0] == "task":
+                    _, spec, refs, pinned, sched_key, tmpl = item
+                    asyncio.ensure_future(self._submit_async(
+                        spec, refs, pinned, sched_key=sched_key,
+                        tmpl=tmpl))
+                else:
+                    _, spec, refs, pinned = item
+                    asyncio.ensure_future(
+                        self._submit_actor_async(spec, refs, pinned))
+            # Going idle: clear the armed flag FIRST, then re-check the
+            # queue. An enqueue racing the final empty check either saw
+            # the flag still armed (caught by this re-check — the
+            # burst's LAST submission must not wait for the next
+            # enqueue's wakeup) or saw it cleared and scheduled a fresh
+            # drain itself. The previous scheme cleared at drain ENTRY,
+            # which made every mid-drain enqueue schedule a spurious
+            # extra wakeup — one self-pipe syscall per task in a
+            # sustained cross-thread burst.
+            self._submit_drain_scheduled = False
+            if not self._pending_submits:
+                return
+            self._submit_drain_scheduled = True
 
     def _make_return_refs(self, task_id: TaskID,
                           num_returns: int) -> List[ObjectRef]:
@@ -1430,7 +1636,8 @@ class ClusterRuntime:
 
     async def _submit_async(self, spec: dict, refs: List[ObjectRef],
                             pinned: Optional[List[ObjectID]] = None,
-                            sched_key: Optional[str] = None) -> None:
+                            sched_key: Optional[str] = None,
+                            tmpl: Optional[SpecTemplate] = None) -> None:
         retries = spec.get("max_retries", 0)
         attempt = 0
         try:
@@ -1440,7 +1647,8 @@ class ClusterRuntime:
                     # a node died, taking this task's upstream objects
                     # with it.
                     await self._resolve_dependencies(spec)
-                    await self._run_on_leased_worker(spec, sched_key)
+                    await self._run_on_leased_worker(spec, sched_key,
+                                                     tmpl)
                     return
                 except (ConnectionLost, RpcError, TimeoutError,
                         asyncio.TimeoutError, OSError) as e:
@@ -1522,7 +1730,8 @@ class ClusterRuntime:
             gen._finish(WCE(f"task {spec['name']}: {message}"))
 
     async def _run_on_leased_worker(self, spec: dict,
-                                    sched_key: Optional[str] = None
+                                    sched_key: Optional[str] = None,
+                                    tmpl: Optional[SpecTemplate] = None
                                     ) -> None:
         pg = spec.get("pg")
         # The submit path hands the template-cached scheduling key down;
@@ -1548,19 +1757,40 @@ class ClusterRuntime:
         worker["push_started"] = push_t0
         worker["push_task_name"] = spec.get("name")
         try:
-            client = await self._worker_client(worker["worker_address"])
-            # Pipelining: once the push is on the wire the lease goes
-            # back into circulation (bounded by worker_pipeline_depth),
-            # so the worker's execution queue stays fed across the
-            # push/reply round trip instead of idling one RTT per task.
-            # _offer_worker gates this on the worker's observed service
-            # time — queueing behind a LONG task would serialize work
-            # that fresh leases (and spillback) could run in parallel.
-            self._offer_worker(key, worker)
-            reply = await client.call(
-                "push_task",
-                spec=to_wire(spec) if hasattr(spec, "_wire_name") else spec,
-                timeout=None)
+            # Submission-ring push (round 8, core/ring.py): a template-
+            # encoded spec bound for a chip-less worker on OUR node can
+            # ride the shm ring — the raylet forwards the delta to the
+            # leased worker and the completion comes back the same way.
+            # Any miss (ring off/failed, no template, remote node, ring
+            # full, oversized delta) falls through to the RPC push.
+            ring_fut = None
+            if (self._ring_enabled and tmpl is not None
+                    and worker.get("raylet_address")
+                    == self.raylet_address
+                    and not worker.get("chip_ids")):
+                ring_fut = await self._ring_enqueue(spec, tmpl, worker)
+            if ring_fut is not None:
+                # Pipelining: the lease recirculates once the entry is
+                # published, exactly like a wire push (see below).
+                self._offer_worker(key, worker)
+                reply = await ring_fut
+            else:
+                client = await self._worker_client(
+                    worker["worker_address"])
+                # Pipelining: once the push is on the wire the lease
+                # goes back into circulation (bounded by
+                # worker_pipeline_depth), so the worker's execution
+                # queue stays fed across the push/reply round trip
+                # instead of idling one RTT per task. _offer_worker
+                # gates this on the worker's observed service time —
+                # queueing behind a LONG task would serialize work that
+                # fresh leases (and spillback) could run in parallel.
+                self._offer_worker(key, worker)
+                reply = await client.call(
+                    "push_task",
+                    spec=(to_wire(spec) if hasattr(spec, "_wire_name")
+                          else spec),
+                    timeout=None)
         except BaseException as push_err:
             # BaseException on purpose: a CancelledError that skipped the
             # decrement would wedge the lease at pipeline>0 forever — the
@@ -1593,8 +1823,224 @@ class ClusterRuntime:
                              else 0.7 * prev + 0.3 * rtt)
         if attribution.enabled:
             attribution.record("submit.push_rtt", rtt)
+        # Feed the inline cost model: exec_us rides every task reply (a
+        # single int), so the EMA converges to the TRUE exec time — a
+        # function that went remote because of one slow run can earn
+        # its way back under the inline threshold.
+        exec_us = reply.get("exec_us") if isinstance(reply, dict) else None
+        if exec_us is not None and spec.get("fn_key"):
+            self._update_fn_cost(spec["fn_key"], exec_us / 1e6)
         self._record_task_reply(spec, reply)
         self._offer_worker(key, worker)
+
+    # -- shared-memory submission ring (round 8; core/ring.py) ---------
+    async def _ensure_submit_ring(self) -> Optional[dict]:
+        """Lazily create the driver<->raylet ring pair (we own the
+        segments/FIFOs; the raylet attaches). Single-flight: every
+        concurrent submit awaits ONE cached setup task — without this,
+        a cold burst's coroutines would each interleave past the `is
+        None` check at the attach await and create orphan ring pairs.
+        A failed setup latches False — the RPC push path is the
+        permanent fallback, never retried per task."""
+        if self._ring is not None:
+            return self._ring or None
+        setup = getattr(self, "_ring_setup", None)
+        if setup is None:
+            setup = self._ring_setup = asyncio.ensure_future(
+                self._setup_submit_ring())
+        await setup
+        return self._ring or None
+
+    async def _setup_submit_ring(self) -> None:
+        files = []
+        writer = reader = None
+        registered_fd = None
+        loop = asyncio.get_running_loop()
+        try:
+            from ray_tpu.core import ring as ringmod
+
+            sub_name, sub_fifo = ringmod.create_ring(
+                "rtsub", self._ring_slots, self._ring_slot_bytes)
+            files.append((sub_name, sub_fifo))
+            comp_name, comp_fifo = ringmod.create_ring(
+                "rtcmp", self._ring_slots, self._ring_slot_bytes)
+            files.append((comp_name, comp_fifo))
+            writer = ringmod.RingWriter(sub_name, sub_fifo)
+            reader = ringmod.RingReader(comp_name, comp_fifo)
+            # Completion fallback (full/oversized completion ring) rides
+            # a server push on the raylet connection; register before
+            # attach so no completion can beat the handler.
+            self._raylet.on_push("ring_completion",
+                                 self._ring_complete_msg)
+            loop.add_reader(reader.doorbell_fd,
+                            self._drain_ring_completions)
+            registered_fd = reader.doorbell_fd
+            await self._raylet.call(
+                "attach_submit_ring", sub_name=sub_name,
+                sub_fifo=sub_fifo, comp_name=comp_name,
+                comp_fifo=comp_fifo, timeout=10.0)
+            self._ring = {
+                "writer": writer, "reader": reader,
+                "files": files,
+                "templates": {}, "next_tmpl": 0,
+                "backstop": asyncio.ensure_future(
+                    self._ring_backstop_loop()),
+            }
+        except Exception:
+            logger.warning("submission ring setup failed; staying on "
+                           "the RPC push path", exc_info=True)
+            # Tear down everything this attempt created: the segments
+            # were deliberately untracked from the resource_tracker, so
+            # nothing else will ever unlink them.
+            if registered_fd is not None:
+                try:
+                    loop.remove_reader(registered_fd)
+                except Exception:
+                    pass
+            for end in (writer, reader):
+                if end is not None:
+                    try:
+                        end.close()
+                    except Exception:
+                        pass
+            from ray_tpu.core.ring import destroy_ring
+
+            for name, fifo in files:
+                destroy_ring(name, fifo)
+            self._ring = False
+
+    async def _ring_enqueue(self, spec: dict, tmpl: SpecTemplate,
+                            worker: dict) -> Optional[Any]:
+        """Publish one template-spec delta; returns the completion
+        future, or None when the entry cannot ride the ring (caller
+        falls back to the RPC push)."""
+        import msgpack
+
+        ring = await self._ensure_submit_ring()
+        if ring is None:
+            return None
+        # One-time template registration per (fn, options, env) shape.
+        # Entries hold (id, registered-future, STRONG template ref):
+        # the future gates concurrent first-users (a delta must never
+        # hit the ring before its template landed at the raylet), and
+        # the strong ref pins the object so a recycled id() can never
+        # alias a stale entry onto the wrong template.
+        entry = ring["templates"].get(id(tmpl))
+        if entry is None:
+            if len(ring["templates"]) >= 512:
+                ring["templates"].clear()   # bounded; re-registers
+            tmpl_id = ring["next_tmpl"]
+            ring["next_tmpl"] += 1
+            reg = asyncio.get_running_loop().create_future()
+            ring["templates"][id(tmpl)] = (tmpl_id, reg, tmpl)
+            try:
+                await self._raylet.call("register_spec_template",
+                                        template_id=tmpl_id,
+                                        base=tmpl._base, timeout=10.0)
+                reg.set_result(True)
+            except Exception:
+                ring["templates"].pop(id(tmpl), None)
+                reg.set_result(False)
+                return None
+        else:
+            tmpl_id, reg = entry[0], entry[1]
+            if not await reg:
+                return None
+        delta = {"t": tmpl_id, "w": worker["worker_id"],
+                 "task_id": spec["task_id"], "args": spec["args"],
+                 "arg_oids": spec.get("arg_oids") or [],
+                 "trace_ctx": spec.get("trace_ctx")}
+        payload = msgpack.packb(delta, use_bin_type=True)
+        fut = asyncio.get_running_loop().create_future()
+        self._ring_waiters[spec["task_id"]] = fut
+        if not ring["writer"].push(payload):
+            # Full ring or oversized delta: not an error, just a miss.
+            self._ring_waiters.pop(spec["task_id"], None)
+            if attribution.enabled:
+                attribution.count("ring.fallback")
+            return None
+        return fut
+
+    def _drain_ring_completions(self) -> None:
+        import msgpack
+
+        ring = self._ring
+        if not ring:
+            return
+        try:
+            drained = ring["reader"].drain()
+        except (OSError, ValueError):
+            return  # ring torn down under the callback
+        for raw in drained:
+            self._ring_complete_msg(msgpack.unpackb(raw, raw=False))
+
+    def _ring_complete_msg(self, msg: Any) -> None:
+        if not isinstance(msg, dict):
+            return
+        fut = self._ring_waiters.pop(msg.get("task_id"), None)
+        if fut is None or fut.done():
+            return
+        err = msg.get("error")
+        if err is not None:
+            if "unknown spec template" in err:
+                # The raylet no longer knows an id we cached (should be
+                # unreachable given its oldest-first eviction bound,
+                # but a raylet restart clears everything): drop OUR
+                # cache so the retry re-registers instead of re-sending
+                # the dead id forever.
+                ring = self._ring
+                if isinstance(ring, dict):
+                    ring["templates"].clear()
+            # Same shape a failed wire push produces: the submit retry
+            # loop treats it as a worker/transport fault.
+            fut.set_exception(ConnectionLost(
+                f"ring dispatch failed: {err}"))
+        else:
+            fut.set_result(msg.get("reply"))
+
+    async def _ring_backstop_loop(self) -> None:
+        """Coarse re-check of the completion ring (lost-wakeup backstop,
+        ring.py docstring) + raylet-death failfast for ring waiters —
+        a dead raylet can never complete them."""
+        from ray_tpu.core.ring import BACKSTOP_POLL_S
+
+        while True:
+            await asyncio.sleep(BACKSTOP_POLL_S)
+            self._drain_ring_completions()
+            if not self._raylet.connected and self._ring_waiters:
+                waiters, self._ring_waiters = self._ring_waiters, {}
+                for fut in waiters.values():
+                    if not fut.done():
+                        fut.set_exception(
+                            ConnectionLost("raylet connection lost with "
+                                           "ring submissions in flight"))
+
+    def _close_submit_ring(self) -> None:
+        ring = self._ring
+        self._ring = False
+        if not isinstance(ring, dict):
+            return
+        from ray_tpu.core.ring import destroy_ring
+
+        backstop = ring.get("backstop")
+        if backstop is not None:
+            try:
+                self._loop.call_soon(backstop.cancel)
+            except Exception:
+                pass
+        try:
+            fd = ring["reader"].doorbell_fd
+            self._loop.call_soon(
+                lambda: self._loop.loop.remove_reader(fd))
+        except Exception:
+            pass
+        for end in (ring["writer"], ring["reader"]):
+            try:
+                end.close()
+            except Exception:
+                pass
+        for name, fifo in ring["files"]:
+            destroy_ring(name, fifo)
 
     def _record_task_reply(self, spec: dict, reply: dict) -> None:
         task_id = spec["task_id"]
@@ -1654,20 +2100,53 @@ class ClusterRuntime:
             return worker
         fut = asyncio.get_running_loop().create_future()
         pool.waiters.append(fut)
-        self._pump_leases(pool, resources, pg)
+        # Coalesced pump (same discipline as _drain_submits): a burst
+        # of acquires lands as N waiters in THIS loop pass, and the one
+        # deferred pump then sees them all — that is what lets a
+        # batched lease RPC carry the whole burst instead of want=1
+        # per waiter.
+        self._schedule_pump(pool, resources, pg)
         return await fut
+
+    def _schedule_pump(self, pool: _LeasePool,
+                       resources: Dict[str, float],
+                       pg: Optional[dict]) -> None:
+        if pool.pump_scheduled:
+            return
+        pool.pump_scheduled = True
+
+        def _run() -> None:
+            pool.pump_scheduled = False
+            self._pump_leases(pool, resources, pg)
+
+        asyncio.get_running_loop().call_soon(_run)
 
     def _pump_leases(self, pool: _LeasePool,
                      resources: Dict[str, float],
                      pg: Optional[dict]) -> None:
-        while pool.inflight_leases < min(len(pool.waiters),
-                                         pool.MAX_INFLIGHT):
-            pool.inflight_leases += 1
-            asyncio.ensure_future(self._fetch_lease(pool, resources, pg))
+        """Keep lease requests pipelined for every queued waiter: RPCs
+        are bounded by MAX_INFLIGHT; with batching on, each RPC asks
+        for up to lease_batch_max grants (one round trip leases a whole
+        burst's workers — the dominant per-task cost PR 5's attribution
+        left on the table)."""
+        batch_max = (self._lease_batch_max
+                     if pg is None and self._lease_batching else 1)
+        # Expected grants are bounded by the SAME allowance the
+        # unbatched pump used (min(waiters, MAX_INFLIGHT)): batching
+        # must collapse the RPC count for a burst, never multiply the
+        # raylet's queue churn past what singles would have caused.
+        allowance = min(len(pool.waiters), pool.MAX_INFLIGHT)
+        while (pool.inflight_rpcs < pool.MAX_INFLIGHT
+               and pool.inflight_leases < allowance):
+            want = min(allowance - pool.inflight_leases, batch_max)
+            pool.inflight_leases += want
+            pool.inflight_rpcs += 1
+            asyncio.ensure_future(
+                self._fetch_lease(pool, resources, pg, want))
 
     async def _fetch_lease(self, pool: _LeasePool,
                            resources: Dict[str, float],
-                           pg: Optional[dict]) -> None:
+                           pg: Optional[dict], want: int = 1) -> None:
         try:
             bundle = None
             address = None
@@ -1675,10 +2154,11 @@ class ClusterRuntime:
                 address, idx = await self._pg_location(
                     pg["pg_id"], pg["bundle_index"], demand=resources)
                 bundle = (pg["pg_id"], idx)
-            worker = await self._request_lease(resources, bundle=bundle,
-                                               address=address)
+            workers = await self._request_leases(
+                resources, want, bundle=bundle, address=address)
         except Exception as e:  # noqa: BLE001
-            pool.inflight_leases -= 1
+            pool.inflight_rpcs -= 1
+            pool.inflight_leases -= want
             for i, fut in enumerate(pool.waiters):
                 if not fut.done():
                     pool.waiters.pop(i)
@@ -1689,8 +2169,34 @@ class ClusterRuntime:
             # wait forever once every inflight request has failed.
             self._pump_leases(pool, resources, pg)
             return
-        pool.inflight_leases -= 1
-        self._hand_worker(pool, worker)
+        pool.inflight_rpcs -= 1
+        pool.inflight_leases -= want
+        if attribution.enabled and want > 1:
+            attribution.value("lease.batch_size", len(workers))
+        for worker in workers:
+            self._hand_worker(pool, worker)
+        # Partial grant ONLY (the raylet had fewer immediately-
+        # grantable workers than asked): the shortfall's waiters lost
+        # their expected grant and need fresh requests. A full grant
+        # never re-pumps — surplus waiters beyond the pipelining cap
+        # are served by lease REUSE, the contract
+        # tests/test_unit_lease_pool pins.
+        if len(workers) < want and pool.waiters:
+            self._pump_leases(pool, resources, pg)
+
+    async def _request_leases(self, resources: Dict[str, float],
+                              n: int,
+                              bundle: Optional[Tuple[str, int]] = None,
+                              address: Optional[str] = None
+                              ) -> List[dict]:
+        """Batched lease request: one raylet RPC for up to `n` workers
+        (reference name parity: request_worker_leases). PG-bundle
+        leases stay single-grant; the reply may be a partial grant —
+        the caller re-pumps."""
+        if n <= 1 or bundle is not None:
+            return [await self._request_lease(resources, bundle=bundle,
+                                              address=address)]
+        return await self._lease_request_loop(resources, n)
 
 
     def _offer_worker(self, key: str, worker: dict) -> None:
@@ -1758,6 +2264,20 @@ class ClusterRuntime:
                              is_actor: bool = False,
                              bundle: Optional[Tuple[str, int]] = None,
                              address: Optional[str] = None) -> dict:
+        grants = await self._lease_request_loop(
+            resources, 1, is_actor=is_actor, bundle=bundle,
+            address=address)
+        return grants[0]
+
+    async def _lease_request_loop(self, resources: Dict[str, float],
+                                  n: int, is_actor: bool = False,
+                                  bundle: Optional[Tuple[str, int]] = None,
+                                  address: Optional[str] = None
+                                  ) -> List[dict]:
+        """The one lease-request state machine, single or batched
+        (n > 1 → request_worker_leases): connect dial policy, spillback
+        chain, cancel-on-timeout and grant bookkeeping live HERE so the
+        two paths can never drift."""
         address = address or self.raylet_address
         # PG-bundle leases are pinned to their reserved node; everything
         # else reached via a non-local address is a spillback target.
@@ -1786,32 +2306,37 @@ class ClusterRuntime:
                 continue
             try:
                 reply = await client.call(
-                    "request_worker_lease",
+                    "request_worker_lease" if n == 1
+                    else "request_worker_leases",
                     req=to_wire(WireLeaseRequest(
                         resources=resources, is_actor=is_actor,
                         spillback_count=spillbacks,
                         bundle=list(bundle) if bundle else None,
                         request_id=request_id,
-                        job_id=self.job_id.hex())),
+                        job_id=self.job_id.hex(), count=n)),
                     timeout=ray_config().worker_lease_timeout_ms / 1000.0)
             except (TimeoutError, asyncio.TimeoutError):
                 # Tell the raylet we gave up: drop the queued request, or
-                # return the worker if it was granted concurrently —
-                # otherwise every timeout+retry would leak one worker.
+                # return the worker(s) if granted concurrently — the
+                # raylet records every grant of this request_id, so one
+                # cancel covers a whole batch (a timed-out client must
+                # not leak N workers).
                 try:
                     await client.call("cancel_lease_request",
                                       request_id=request_id, timeout=5.0)
                 except Exception:
                     pass
                 raise
-            if reply.get("granted"):
-                info = reply["granted"]
-                info["raylet_address"] = address
-                if not is_actor:
-                    # Actor leases live as long as the actor; only task
-                    # leases are watchdog-swept for orphaning.
-                    self._live_leases.append(info)
-                return info
+            grants = reply.get("grants") or (
+                [reply["granted"]] if reply.get("granted") else None)
+            if grants:
+                for info in grants:
+                    info["raylet_address"] = address
+                    if not is_actor:
+                        # Actor leases live as long as the actor; only
+                        # task leases are watchdog-swept for orphaning.
+                        self._live_leases.append(info)
+                return grants
             if reply.get("spillback"):
                 address = reply["spillback"]
                 spillbacks += 1
@@ -2764,6 +3289,11 @@ class ClusterRuntime:
         """Returns (args, kwargs, arg_refs) where arg_refs is the list of
         (oid, owner) pairs for every ref deserialized from the payload —
         the input for _commit_arg_borrows at task completion."""
+        if args_blob is ClusterRuntime._empty_args_blob:
+            # Inline fast path: the shared zero-arg blob (identity, not
+            # equality — a wire copy never matches) decodes to a known
+            # constant; skip the unpickle.
+            return (), {}, []
         _deser_ctx.suppress_borrow = True
         _deser_ctx.arg_refs = []
         try:
@@ -2915,6 +3445,10 @@ class ClusterRuntime:
         attr_on = attribution.enabled
         split = {"arg_resolve": 0, "exec": 0, "result_pack": 0}
         _tmark = time.perf_counter() if attr_on else 0.0
+        # exec_us rides EVERY successful reply (one int, two clock
+        # reads): it feeds the owner's per-fn cost EMA that gates the
+        # inline fast path (_inline_eligible).
+        exec_us: Optional[int] = None
         try:
             if task_id in self._cancelled_pending:
                 raise TaskCancelledError(task_id)
@@ -2930,6 +3464,7 @@ class ClusterRuntime:
                 now = time.perf_counter()
                 split["arg_resolve"] = int((now - _tmark) * 1e6)
                 _tmark = now
+            _e0 = time.perf_counter()
             if tracing_enabled() or spec.get("trace_ctx"):
                 # Execution span parents to the CALLER's span via the
                 # propagated traceparent (reference: tracing_helper's
@@ -2941,6 +3476,7 @@ class ClusterRuntime:
                     value = fn(*args, **kwargs)
             else:
                 value = fn(*args, **kwargs)
+            exec_us = int((time.perf_counter() - _e0) * 1e6)
             if attr_on:
                 now = time.perf_counter()
                 split["exec"] = int((now - _tmark) * 1e6)
@@ -2967,9 +3503,12 @@ class ClusterRuntime:
                 task_id, name, "FINISHED" if ok else "FAILED",
                 job_id=spec.get("job_id"))
             _reset_task_context(token)
+        reply: Dict[str, Any] = {"results": results}
+        if exec_us is not None:
+            reply["exec_us"] = exec_us
         if attr_on:
-            return {"results": results, "attr_exec": split}
-        return {"results": results}
+            reply["attr_exec"] = split
+        return reply
 
     def _package_returns(self, task_id: str, num_returns: int, name: str,
                          value: Any) -> List[dict]:

@@ -145,6 +145,41 @@ _D("borrow_commit_timeout_s", 35.0,
    "Deadline for registering retained arg borrows with owners at task "
    "completion (reference: borrowed-refs report in the task reply).")
 
+# -- task-plane fast paths (round 8) -------------------------------------
+_D("task_inline_execution", True,
+   "Same-process inline execution of tiny tasks: when a task's options "
+   "are pure defaults, its ObjectRef args are all locally resolved, and "
+   "the function's observed exec-time EMA sits below "
+   "task_inline_threshold_ms, run it on the caller thread instead of "
+   "leasing a worker (reference: local-mode short circuit, promoted to "
+   "a per-task dynamic decision). Disabling restores pure-remote "
+   "submission for every task.")
+_D("task_inline_threshold_ms", 1.0,
+   "Exec-time EMA ceiling for inline execution, in milliseconds. The "
+   "EMA starts unknown (first calls go remote and report exec_us in "
+   "their replies), so a long or blocking task is never inlined on "
+   "spec. Break-even on an N-core box is roughly "
+   "per-task-overhead / (N - 1).")
+_D("lease_batching", True,
+   "Batch worker-lease grants: one request_worker_leases RPC asks the "
+   "raylet for up to lease_batch_max workers for a submission burst, "
+   "collapsing the per-task lease round trip (reference: the pipelined "
+   "lease requests of direct_task_transport, batched).")
+_D("lease_batch_max", 8,
+   "Max leases requested in one batched lease RPC.")
+_D("submit_ring", False,
+   "Shared-memory submission ring between driver and local raylet: "
+   "task-spec deltas ride a fixed-slot SPSC shm ring (zero syscalls "
+   "per task steady-state; doorbell byte only on the empty->non-empty "
+   "edge) and the raylet forwards them to the leased worker. "
+   "Experimental: off by default; the RPC push path is the fallback "
+   "for every condition the ring cannot carry.")
+_D("submit_ring_slots", 128,
+   "Slot count of each submission/completion ring.")
+_D("submit_ring_slot_bytes", 8192,
+   "Slot payload capacity; a spec delta larger than this falls back "
+   "to the RPC push path.")
+
 # -- tensor plane --------------------------------------------------------
 _D("tpu_slice_gang_scheduling", True,
    "Treat a TPU slice as an atomic gang for placement-group scheduling.")

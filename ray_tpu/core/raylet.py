@@ -22,7 +22,8 @@ from typing import Any, Dict, List, Optional
 from ray_tpu.core.config import ray_config
 from ray_tpu.core.gcs.client import GcsClient
 from ray_tpu.core.object_store import NativeObjectStore, make_store
-from ray_tpu.core.rpc import RpcClient, RpcServer, ServerConnection
+from ray_tpu.core.rpc import (RpcClient, RpcError, RpcServer,
+                              ServerConnection)
 
 logger = logging.getLogger(__name__)
 
@@ -204,9 +205,11 @@ class Raylet:
         self._monitors: Dict[str, asyncio.Task] = {}
         # worker_id -> (monotonic push time, app-metric snapshot)
         self._worker_metrics: Dict[str, tuple] = {}
-        # lease request_id -> (lease_id, worker_id), for cancel-after-
-        # grant (a client that timed out must not leak the worker).
-        self._recent_grants: Dict[str, tuple] = {}
+        # lease request_id -> [(lease_id, worker_id), ...], for cancel-
+        # after-grant (a client that timed out must not leak the
+        # worker); list-valued since one batched request can grant
+        # several leases under the same request_id.
+        self._recent_grants: Dict[str, list] = {}
         # live lease_id -> (worker_id, granting connection): a client
         # that dies (not merely times out) can never use or return its
         # grants, so disconnect reclaims them.
@@ -647,21 +650,9 @@ class Raylet:
             self._pending.append(pending)
             self._try_dispatch()
             return await pending.future
-        cfg = ray_config()
-        local_fits = self._fits(self.resources_available, demand)
-        # Hybrid policy (hybrid_scheduling_policy.h): pack locally while
-        # below the spread threshold; above it — or when local can't fit —
-        # spill to a viable remote. The spillback chain is bounded so two
-        # saturated raylets with stale views of each other can't ping-pong
-        # a lease forever.
-        if spillback_count < 2:
-            utilization = 1.0 - (
-                self.resources_available.get("CPU", 0.0)
-                / max(self.resources_total.get("CPU", 1.0), 1e-9))
-            if not local_fits or utilization > cfg.scheduler_spread_threshold:
-                remote = self._pick_spillback(demand)
-                if remote is not None:
-                    return {"spillback": remote}
+        remote = self._maybe_spillback(demand, spillback_count)
+        if remote is not None:
+            return {"spillback": remote}
         # Locally-infeasible demands queue rather than fail (reference:
         # infeasible tasks wait in the cluster task manager until the
         # cluster changes — e.g. the node with that resource is still
@@ -677,6 +668,108 @@ class Raylet:
 
     def _feasible_locally(self, demand: Dict[str, float]) -> bool:
         return self._fits(self.resources_total, demand)
+
+    def _maybe_spillback(self, demand: Dict[str, float],
+                         spillback_count: int) -> Optional[str]:
+        """Hybrid policy (hybrid_scheduling_policy.h): pack locally
+        while below the spread threshold; above it — or when local
+        can't fit — spill to a viable remote. The spillback chain is
+        bounded so two saturated raylets with stale views of each
+        other can't ping-pong a lease forever. One helper shared by
+        the single and batched lease handlers, so the policy cannot
+        diverge between them."""
+        if spillback_count >= 2:
+            return None
+        local_fits = self._fits(self.resources_available, demand)
+        utilization = 1.0 - (
+            self.resources_available.get("CPU", 0.0)
+            / max(self.resources_total.get("CPU", 1.0), 1e-9))
+        if (not local_fits or utilization
+                > ray_config().scheduler_spread_threshold):
+            return self._pick_spillback(demand)
+        return None
+
+    async def handle_request_worker_leases(
+            self, conn: ServerConnection, *,
+            req: dict) -> Dict[str, Any]:
+        """Batched lease grants (round 8): one RPC asks for up to
+        `req.count` workers. Everything immediately grantable (idle
+        worker + resources, through the SAME `_try_dispatch` machinery
+        single leases use) returns at once as a partial grant — the
+        client re-pumps for the shortfall; when nothing is grantable
+        now, workers are prestarted for the whole burst width and the
+        request degrades to the single-lease semantics (queueing,
+        hybrid-policy spillback), so contention behavior matches the
+        unbatched path — which queued one pending per task and thereby
+        spawned the burst's workers in parallel."""
+        from ray_tpu.core.wire import from_wire
+
+        lr = from_wire(req, expect="LeaseRequest")
+        count = max(1, int(lr.get("count") or 1))
+        demand = {k: float(v) for k, v in lr.resources.items() if v}
+        # Hybrid-policy parity with the single-lease path: a node past
+        # the spread threshold (or that can't fit the demand) spills
+        # the whole batch rather than packing onto a local idle worker
+        # the unbatched path would have sent away.
+        if lr.bundle is None:
+            remote = self._maybe_spillback(demand, lr.spillback_count)
+            if remote is not None:
+                return {"spillback": remote}
+        grants: List[Dict[str, Any]] = []
+        if lr.bundle is None:
+            while len(grants) < count:
+                granted = self._try_grant_now(
+                    demand, lr.is_actor, lr.scheduling_key, conn,
+                    lr.request_id, lr.job_id)
+                if granted is None:
+                    break
+                grants.append(granted)
+        if grants:
+            return {"grants": grants}
+        # Dry node with FREE resources (the shortage is worker
+        # processes, not CPUs): prestart workers for the whole burst
+        # before degrading to one queued single lease — the probe only
+        # ever exposed a pending depth of 1 to _try_dispatch's spawn
+        # loop, so without this an N-task cold burst would spawn its
+        # workers serially, one per grant round trip (the unbatched
+        # path queued N pendings and spawned N at once). When resources
+        # are the constraint, spawning would only stack idle processes.
+        if (lr.bundle is None
+                and self._fits(self.resources_available, demand)):
+            starting = sum(1 for w in self._workers.values()
+                           if w.state == "starting")
+            for _ in range(count - starting):
+                if not self._can_start_worker(for_actor=lr.is_actor):
+                    break
+                self._spawn_worker()
+        return await self.handle_request_worker_lease(
+            conn, resources=lr.resources,
+            scheduling_key=lr.scheduling_key, is_actor=lr.is_actor,
+            spillback_count=lr.spillback_count, bundle=lr.bundle,
+            request_id=lr.request_id, job_id=lr.job_id)
+
+    def _try_grant_now(self, demand: Dict[str, float], is_actor: bool,
+                       scheduling_key: str, conn, request_id, job_id
+                       ) -> Optional[Dict[str, Any]]:
+        """One immediate grant through `_try_dispatch`, or None without
+        queueing anything (the batch handler withdraws the probe)."""
+        pending = _PendingLease(demand, is_actor, scheduling_key,
+                                request_id=request_id, job_id=job_id)
+        pending.conn = conn
+        self._pending.append(pending)
+        self._try_dispatch()
+        if pending.future.done():
+            reply = pending.future.result()
+            granted = reply.get("granted")
+            if granted is not None:
+                return granted
+            return None
+        try:
+            self._pending.remove(pending)
+        except ValueError:
+            pass
+        pending.future.cancel()
+        return None
 
     # ------------------------------------------------------------------
     # metrics (reference: stats/metric_defs.h runtime metrics + the
@@ -815,8 +908,9 @@ class Raylet:
                 self._lease_conns[lease_id] = (worker.worker_id,
                                                pending.conn)
                 if pending.request_id is not None:
-                    self._recent_grants[pending.request_id] = (
-                        lease_id, worker.worker_id)
+                    self._recent_grants.setdefault(
+                        pending.request_id, []).append(
+                            (lease_id, worker.worker_id))
                     while len(self._recent_grants) > 256:
                         self._recent_grants.pop(
                             next(iter(self._recent_grants)))
@@ -943,11 +1037,12 @@ class Raylet:
                 if not pending.future.done():
                     pending.future.cancel()
                 return True
-        grant = self._recent_grants.pop(request_id, None)
-        if grant is not None:
-            lease_id, worker_id = grant
-            return await self.handle_return_worker(
-                conn, lease_id=lease_id, worker_id=worker_id)
+        grants = self._recent_grants.pop(request_id, None)
+        if grants:
+            for lease_id, worker_id in grants:
+                await self.handle_return_worker(
+                    conn, lease_id=lease_id, worker_id=worker_id)
+            return True
         return False
 
     async def handle_return_worker(self, conn: ServerConnection, *,
@@ -1257,11 +1352,138 @@ class Raylet:
                 n += 1
         return n
 
+    # ------------------------------------------------------------------
+    # shared-memory submission ring (round 8; core/ring.py)
+    # ------------------------------------------------------------------
+    async def handle_attach_submit_ring(self, conn: ServerConnection, *,
+                                        sub_name: str, sub_fifo: str,
+                                        comp_name: str, comp_fifo: str
+                                        ) -> bool:
+        """A node-local driver created a ring pair (it owns the segments
+        and FIFOs): attach the submit side as consumer, the completion
+        side as producer, and wake on the submit doorbell. Task-spec
+        deltas dequeued here are forwarded to the worker the DRIVER
+        leased (the lease plane is untouched — the ring replaces only
+        the driver->worker push hop with driver->shm->raylet->worker,
+        trading the driver's per-task socket write for plain stores)."""
+        from ray_tpu.core.ring import RingReader, RingWriter
+
+        self._detach_submit_ring(conn)
+        state = {
+            "reader": RingReader(sub_name, sub_fifo),
+            "writer": RingWriter(comp_name, comp_fifo),
+            "templates": {},
+            "conn": conn,
+        }
+        conn.metadata["submit_ring"] = state
+        loop = asyncio.get_running_loop()
+        loop.add_reader(state["reader"].doorbell_fd,
+                        self._on_ring_doorbell, state)
+        state["poller"] = asyncio.ensure_future(self._ring_backstop(state))
+        return True
+
+    async def handle_register_spec_template(self, conn: ServerConnection,
+                                            *, template_id: int,
+                                            base: dict) -> bool:
+        """Invariant wire dict of a spec template, registered once per
+        (fn, options, env) shape; ring deltas reference it by id so the
+        steady-state entry carries only per-call fields."""
+        state = conn.metadata.get("submit_ring")
+        if state is None:
+            raise RpcError("no submission ring attached on this "
+                           "connection")
+        while len(state["templates"]) >= 1024:
+            # Evict OLDEST-first (insertion order), never wholesale:
+            # the driver's own map clears at 512 and re-registers under
+            # fresh monotonic ids, so any id the driver still holds is
+            # among the newest <=512 registrations — evicting from the
+            # old end can therefore never invalidate a live id, while
+            # keeping this per-connection registry bounded.
+            state["templates"].pop(next(iter(state["templates"])))
+        state["templates"][int(template_id)] = base
+        return True
+
+    def _on_ring_doorbell(self, state: dict) -> None:
+        try:
+            drained = state["reader"].drain()
+        except (OSError, ValueError):
+            return  # ring torn down under the callback
+        for raw in drained:
+            asyncio.ensure_future(self._dispatch_ring_task(state, raw))
+
+    async def _ring_backstop(self, state: dict) -> None:
+        """Lost-wakeup backstop (ring.py module docstring): re-check the
+        ring on a coarse timer so a doorbell lost to the cross-process
+        publish race costs one poll period, not a hang."""
+        from ray_tpu.core.ring import BACKSTOP_POLL_S
+
+        while not state["reader"].closed:
+            await asyncio.sleep(BACKSTOP_POLL_S)
+            try:
+                self._on_ring_doorbell(state)
+            except Exception:
+                return  # ring torn down under us
+
+    async def _dispatch_ring_task(self, state: dict, raw: bytes) -> None:
+        import msgpack
+
+        delta = msgpack.unpackb(raw, raw=False)
+        task_id = delta.get("task_id")
+        try:
+            base = state["templates"].get(delta.pop("t", None))
+            worker_id = delta.pop("w", None)
+            if base is None:
+                raise RpcError("unknown spec template")
+            spec = dict(base)
+            spec.update(delta)
+            worker = self._workers.get(worker_id)
+            if (worker is None or worker.address is None
+                    or worker.proc.poll() is not None):
+                raise RpcError("leased worker is gone")
+            client = await self._worker_client(worker.address)
+            reply = await client.call("push_task", spec=spec,
+                                      timeout=None)
+            self._ring_complete(state, {"task_id": task_id,
+                                        "reply": reply})
+        except Exception as e:  # noqa: BLE001
+            # A typed completion error: the driver maps it onto the same
+            # ConnectionLost/retry path a failed RPC push takes.
+            self._ring_complete(state, {
+                "task_id": task_id,
+                "error": f"{type(e).__name__}: {e}"})
+
+    def _ring_complete(self, state: dict, msg: dict) -> None:
+        import msgpack
+
+        payload = msgpack.packb(msg, use_bin_type=True)
+        if not state["writer"].push(payload):
+            # Completion ring full or the reply exceeds a slot: deliver
+            # over the attach connection instead (server push) — a
+            # completion must never be dropped.
+            asyncio.ensure_future(
+                state["conn"].push("ring_completion", msg))
+
+    def _detach_submit_ring(self, conn: ServerConnection) -> None:
+        state = conn.metadata.pop("submit_ring", None)
+        if state is None:
+            return
+        poller = state.get("poller")
+        if poller is not None:
+            poller.cancel()
+        try:
+            asyncio.get_running_loop().remove_reader(
+                state["reader"].doorbell_fd)
+        except Exception:
+            pass
+        state["reader"].close()
+        state["writer"].close()
+
     async def on_client_disconnect(self, conn: ServerConnection) -> None:
         """Drop queued lease requests from a vanished client so a later
         grant doesn't strand a worker + its resources, and reclaim
         leases it was already granted (a dead client can never use or
         return them)."""
+        self._detach_submit_ring(conn)
         for pending in [p for p in self._pending if p.conn is conn]:
             self._pending.remove(pending)
             if not pending.future.done():

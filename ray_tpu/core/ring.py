@@ -1,0 +1,267 @@
+"""Shared-memory SPSC submission ring + doorbell (round 8).
+
+Reference intuition: the zero-syscall submission queues of io_uring /
+virtio — producer and consumer share a fixed-slot ring in mapped memory;
+publishing an entry is a pair of plain stores, and the *only* syscall is
+a doorbell written on the empty→non-empty edge to wake a sleeping
+consumer. Here the ring carries task-spec deltas between a driver and
+its node-local raylet (`cluster_runtime._push_via_ring` →
+`raylet._drain_submit_ring`), with a twin ring carrying completions
+back.
+
+Layout of the shm segment (one ring per segment; reuses the raw
+`shm_open+mmap` attach machinery of `object_store.attach_segment`, so
+attaching costs no resource-tracker traffic):
+
+    [0:8)    head  u64  — consumer cursor (slots consumed), consumer-written
+    [8:16)   tail  u64  — producer cursor (slots published), producer-written
+    [16:20)  nslots u32
+    [20:24)  slot_bytes u32 (payload capacity per slot)
+    [24:25)  closed u8 — either side sets it; the other observes
+    [64:...) nslots slots of (u32 length + payload)
+
+Single producer, single consumer, distinct processes. Cursors only ever
+grow (mod 2^64); `tail - head` is the fill level. The producer writes
+the slot payload *then* publishes by storing tail; the consumer reads
+head's slot then releases it by storing head. CPython's struct stores
+into the mmap are plain memory writes — on the cache-coherent hosts
+this targets, publication order holds at the producer's bytecode
+granularity (each interpreter step is far coarser than a store-buffer
+drain).
+
+Doorbell: a named FIFO next to the segment. The producer writes ONE
+byte only when its push found the ring empty (`tail == head` before the
+push); steady-state pushes into a non-empty ring are pure memory
+writes — zero syscalls per task. The consumer registers the FIFO fd
+with its event loop, drains the FIFO and then the ring on wakeup.
+There is a textbook lost-wakeup window (consumer drains to empty while
+the producer concurrently pushes and judges the ring non-empty from a
+stale head); consumers close it with a coarse backstop poll
+(`BACKSTOP_POLL_S`) rather than a cross-process fence — a 50 ms blip on
+a nanosecond-wide race, and the hot loop stays syscall-free.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+from multiprocessing import shared_memory
+from typing import List, Optional, Tuple
+
+from ray_tpu.core import attribution
+
+_HDR = struct.Struct("<QQII")          # head, tail, nslots, slot_bytes
+_LEN = struct.Struct("<I")
+HEADER_BYTES = 64
+_CLOSED_OFF = 24
+
+# Consumers sleep at most this long before re-checking the ring even
+# without a doorbell (lost-wakeup backstop; see module docstring).
+BACKSTOP_POLL_S = 0.05
+
+
+def ring_bytes(nslots: int, slot_bytes: int) -> int:
+    return HEADER_BYTES + nslots * (_LEN.size + slot_bytes)
+
+
+def create_ring(name_hint: str, nslots: int, slot_bytes: int
+                ) -> Tuple[str, str]:
+    """Create the shm segment + doorbell FIFO for one ring. Returns
+    (segment_name, fifo_path). The creator owns both files' lifetime
+    (`destroy_ring`)."""
+    shm = shared_memory.SharedMemory(
+        name=f"{name_hint}_{os.getpid()}_{os.urandom(4).hex()}",
+        create=True, size=ring_bytes(nslots, slot_bytes))
+    _HDR.pack_into(shm.buf, 0, 0, 0, nslots, slot_bytes)
+    shm.buf[_CLOSED_OFF] = 0
+    name = shm.name.lstrip("/")
+    fifo = f"/tmp/{name}.fifo"
+    os.mkfifo(fifo)
+    # Keep only the name: both ends re-attach with the raw machinery
+    # (object_store.attach_segment); this handle's resource-tracker
+    # registration is dropped so a creator crash can't double-unlink.
+    from ray_tpu.core.object_store import _untrack
+
+    _untrack(shm)
+    shm.close()
+    return name, fifo
+
+
+def destroy_ring(name: str, fifo: str) -> None:
+    try:
+        os.unlink(f"/dev/shm/{name}")
+    except OSError:
+        pass
+    try:
+        os.unlink(fifo)
+    except OSError:
+        pass
+
+
+class _Ring:
+    """Shared base: attach + cursor accessors."""
+
+    def __init__(self, name: str, fifo: str):
+        from ray_tpu.core.object_store import attach_segment
+
+        self._seg = attach_segment(name)
+        self.buf = self._seg.buf
+        _h, _t, self.nslots, self.slot_bytes = _HDR.unpack_from(self.buf, 0)
+        self.name = name
+        self.fifo = fifo
+        self._slot_stride = _LEN.size + self.slot_bytes
+
+    # Cursors are u64 plain loads/stores on the mapped header.
+    @property
+    def head(self) -> int:
+        return struct.unpack_from("<Q", self.buf, 0)[0]
+
+    @head.setter
+    def head(self, v: int) -> None:
+        struct.pack_into("<Q", self.buf, 0, v)
+
+    @property
+    def tail(self) -> int:
+        return struct.unpack_from("<Q", self.buf, 8)[0]
+
+    @tail.setter
+    def tail(self, v: int) -> None:
+        struct.pack_into("<Q", self.buf, 8, v)
+
+    @property
+    def closed(self) -> bool:
+        return bool(self.buf[_CLOSED_OFF])
+
+    def mark_closed(self) -> None:
+        try:
+            self.buf[_CLOSED_OFF] = 1
+        except (TypeError, ValueError):
+            pass  # segment already torn down
+
+    def _slot_off(self, cursor: int) -> int:
+        return HEADER_BYTES + (cursor % self.nslots) * self._slot_stride
+
+    def close(self) -> None:
+        try:
+            self._seg.close()
+        except BufferError:
+            pass  # a drained payload view still aliases the mapping
+
+
+class RingWriter(_Ring):
+    """Producer end. `push` is wait-free: a full ring returns False and
+    the caller takes its fallback path (RPC push) instead of blocking."""
+
+    def __init__(self, name: str, fifo: str):
+        super().__init__(name, fifo)
+        self._fifo_fd: Optional[int] = None
+
+    def _doorbell(self) -> None:
+        if self._fifo_fd is None:
+            try:
+                self._fifo_fd = os.open(self.fifo,
+                                        os.O_WRONLY | os.O_NONBLOCK)
+            except OSError:
+                return  # no reader yet: its attach-time drain catches up
+        try:
+            os.write(self._fifo_fd, b"\x01")
+        except (BlockingIOError, BrokenPipeError, OSError):
+            pass  # FIFO full (reader behind but awake) or reader gone
+        if attribution.enabled:
+            attribution.count("ring.doorbell")
+
+    def push(self, payload: bytes) -> bool:
+        """Publish one entry; False when the ring is full, closed, or
+        the payload exceeds the slot capacity (caller falls back)."""
+        n = len(payload)
+        if n > self.slot_bytes or self.closed:
+            return False
+        head, tail = self.head, self.tail
+        if tail - head >= self.nslots:
+            return False  # full: overflow is the caller's fallback
+        off = self._slot_off(tail)
+        _LEN.pack_into(self.buf, off, n)
+        self.buf[off + _LEN.size:off + _LEN.size + n] = payload
+        # Publish AFTER the payload lands: the consumer never reads past
+        # tail, so a half-written slot is unreachable.
+        self.tail = tail + 1
+        if attribution.enabled:
+            attribution.count("ring.enq")
+        if tail == head:
+            self._doorbell()  # empty->non-empty edge only
+        return True
+
+    def close(self) -> None:
+        self.mark_closed()
+        if self._fifo_fd is not None:
+            try:
+                os.close(self._fifo_fd)
+            except OSError:
+                pass
+            self._fifo_fd = None
+        super().close()
+
+
+class RingReader(_Ring):
+    """Consumer end. Exposes the doorbell fd for event-loop
+    registration; `drain()` empties the FIFO and the ring."""
+
+    def __init__(self, name: str, fifo: str):
+        super().__init__(name, fifo)
+        # O_RDWR (not O_RDONLY): keeps a writer reference on the FIFO so
+        # the producer's open never races EOF when re-opening, and a
+        # nonblocking open succeeds with no producer present.
+        self.doorbell_fd = os.open(fifo, os.O_RDWR | os.O_NONBLOCK)
+
+    def clear_doorbell(self) -> None:
+        try:
+            while os.read(self.doorbell_fd, 4096):
+                pass
+        except (BlockingIOError, OSError):
+            pass
+
+    def pop(self) -> Optional[bytes]:
+        """One entry (as immutable bytes — copied out so the slot can be
+        reused immediately), or None when empty."""
+        head = self.head
+        if self.tail == head:
+            return None
+        off = self._slot_off(head)
+        (n,) = _LEN.unpack_from(self.buf, off)
+        payload = bytes(self.buf[off + _LEN.size:off + _LEN.size + n])
+        self.head = head + 1  # release the slot after the copy
+        if attribution.enabled:
+            attribution.count("ring.deq")
+        return payload
+
+    def drain(self) -> List[bytes]:
+        self.clear_doorbell()
+        out = []
+        while True:
+            item = self.pop()
+            if item is None:
+                return out
+            out.append(item)
+
+    def wait_nonempty(self, timeout: float) -> bool:
+        """Blocking helper for threaded consumers (tests): True when an
+        entry is available within `timeout`."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.tail != self.head:
+                return True
+            import select
+
+            select.select([self.doorbell_fd], [], [],
+                          min(BACKSTOP_POLL_S,
+                              max(0.0, deadline - time.monotonic())))
+        return self.tail != self.head
+
+    def close(self) -> None:
+        self.mark_closed()
+        try:
+            os.close(self.doorbell_fd)
+        except OSError:
+            pass
+        super().close()

@@ -354,6 +354,10 @@ class LeaseRequest:
     is_actor: bool = False
     spillback_count: int = 0
     bundle: Optional[list] = None      # (pg_id, bundle_index)
+    # Batched grants (round 8): ask for up to `count` workers in one RPC
+    # (request_worker_leases). Optional-with-default per the evolution
+    # rules: old peers omit it, new peers fill 1 on decode.
+    count: int = 1
 
 
 @wire_message("LeaseReply", version=1)
@@ -364,6 +368,9 @@ class LeaseReply:
     spillback: Optional[str] = None    # retry at this raylet instead
     error: Optional[str] = None
     detail: Optional[str] = None
+    # Batched grants: list of worker-info dicts, possibly shorter than
+    # the requested count (partial grant — the client re-pumps).
+    grants: Optional[list] = None
 
 
 @wire_message("ObjectRequest", version=1)

@@ -1,0 +1,166 @@
+"""Cluster integration: round-8 task-plane fast paths on a real node.
+
+Semantics the tentpole must preserve (ISSUE 8 acceptance): inline
+results are real ObjectRefs (gettable, passable as args), failures
+surface through the same typed error path as remote execution,
+task_events fire exactly once per task, and disabling the fast path
+restores pure-remote dispatch. The submission ring runs end-to-end in
+its own cluster (flag-gated; parity with the RPC push path).
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+pytestmark = pytest.mark.cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _warm(fn, n: int = 20):
+    """Feed the per-fn exec EMA: remote replies carry exec_us, so after
+    one remote burst a tiny function is known-tiny."""
+    ray_tpu.get([fn.remote() for _ in range(n)], timeout=120)
+
+
+def test_inline_engages_after_remote_warmup(cluster):
+    @ray_tpu.remote
+    def mypid():
+        return os.getpid()
+
+    # Cold function: EMA unknown -> every call goes remote (pessimistic
+    # start — a blocking task must never be inlined on spec).
+    first = ray_tpu.get(mypid.remote(), timeout=60)
+    assert first != os.getpid()
+    _warm(mypid)
+    # Known-tiny: dispatch moves to the caller process.
+    assert ray_tpu.get(mypid.remote(), timeout=60) == os.getpid()
+
+
+def test_inline_refs_are_real_objectrefs(cluster):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    ray_tpu.get([add.remote(1, 1) for _ in range(20)], timeout=120)
+    r1 = add.remote(3, 4)            # inline by now
+    # Gettable, passable as an arg (resolved-local gate), re-gettable.
+    r2 = add.remote(r1, 10)
+    assert ray_tpu.get(r2, timeout=60) == 17
+    assert ray_tpu.get(r1, timeout=60) == 7
+    # Multi-return parity.
+    pair = ray_tpu.remote(num_returns=2)(lambda: (1, 2))
+    a, b = pair.remote()
+    assert ray_tpu.get([a, b], timeout=60) == [1, 2]
+
+
+def test_inline_errors_take_the_typed_remote_path(cluster):
+    @ray_tpu.remote
+    def sometimes(x):
+        if x:
+            raise ValueError("inline-kapow")
+        return "ok"
+
+    ray_tpu.get([sometimes.remote(False) for _ in range(20)],
+                timeout=120)
+    # Inline execution now; the exception must surface at get() exactly
+    # like a remote failure (RayTaskError unwrap to the user type).
+    with pytest.raises(ValueError, match="inline-kapow"):
+        ray_tpu.get(sometimes.remote(True), timeout=60)
+    # The fn stays inline-eligible (errors are cheap, EMA unaffected by
+    # the raise path) and later successes still work.
+    assert ray_tpu.get(sometimes.remote(False), timeout=60) == "ok"
+
+
+def test_inline_task_events_fire_exactly_once(cluster):
+    @ray_tpu.remote
+    def evt():
+        return 1
+
+    ray_tpu.get([evt.remote() for _ in range(20)], timeout=120)
+    ref = evt.remote()               # inline
+    assert ray_tpu.get(ref, timeout=60) == 1
+    task_hex = ref.id().task_id().hex()
+    rt = ray_tpu.core.worker.current_runtime()
+    deadline = time.monotonic() + 10
+    counts = {}
+    while time.monotonic() < deadline:
+        events = [e for e in rt.task_events()
+                  if e.get("task_id") == task_hex]
+        counts = {}
+        for e in events:
+            counts[e.get("event")] = counts.get(e.get("event"), 0) + 1
+        if counts.get("FINISHED"):
+            break
+        time.sleep(0.25)
+    # No phantom submissions/executions: one of each lifecycle event.
+    assert counts.get("SUBMITTED") == 1, counts
+    assert counts.get("RUNNING") == 1, counts
+    assert counts.get("FINISHED") == 1, counts
+
+
+def test_cancel_of_completed_inline_task_is_noop(cluster):
+    @ray_tpu.remote
+    def quick():
+        return 5
+
+    ray_tpu.get([quick.remote() for _ in range(20)], timeout=120)
+    ref = quick.remote()             # inline: already resolved
+    ray_tpu.cancel(ref)              # reference semantics: no-op
+    assert ray_tpu.get(ref, timeout=60) == 5
+
+
+def test_disabling_inline_restores_pure_remote(cluster):
+    @ray_tpu.remote
+    def whoami():
+        return os.getpid()
+
+    ray_tpu.get([whoami.remote() for _ in range(20)], timeout=120)
+    rt = ray_tpu.core.worker.current_runtime()
+    assert ray_tpu.get(whoami.remote(), timeout=60) == os.getpid()
+    # The config gate (snapshotted on the runtime) fully restores
+    # remote dispatch; so does the per-call _metadata opt-out.
+    rt._inline_enabled = False
+    try:
+        assert ray_tpu.get(whoami.remote(), timeout=60) != os.getpid()
+    finally:
+        rt._inline_enabled = True
+    opted_out = whoami.options(_metadata={"inline": False})
+    assert ray_tpu.get(opted_out.remote(), timeout=60) != os.getpid()
+
+
+def test_submit_ring_end_to_end_parity():
+    # Own cluster: the ring is flag-gated and the flag snapshots at
+    # runtime construction.
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, _system_config={
+        "submit_ring": True, "task_inline_execution": False})
+    try:
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        @ray_tpu.remote
+        def boom():
+            raise RuntimeError("ring-kapow")
+
+        assert ray_tpu.get([add.remote(i, 1) for i in range(50)],
+                           timeout=120) == [i + 1 for i in range(50)]
+        rt = ray_tpu.core.worker.current_runtime()
+        # The ring actually engaged (not silently falling back forever).
+        assert isinstance(rt._ring, dict), rt._ring
+        with pytest.raises(RuntimeError, match="ring-kapow"):
+            ray_tpu.get(boom.remote(), timeout=60)
+        # Refs produced over the ring stay first-class.
+        r = add.remote(add.remote(1, 2), 4)
+        assert ray_tpu.get(r, timeout=60) == 7
+    finally:
+        ray_tpu.shutdown()

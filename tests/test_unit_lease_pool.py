@@ -21,18 +21,21 @@ pytestmark = pytest.mark.unit
 class _Harness(ClusterRuntime):
     """ClusterRuntime with only lease-pool state, faked lease RPCs."""
 
-    def __init__(self, fail_first: int = 0):
+    def __init__(self, fail_first: int = 0, batching: bool = False,
+                 grant_cap: int = 0):
         self._lease_pools = {}
         self._live_leases = []
         self._pipeline_depth = ray_config().worker_pipeline_depth
         self._pipeline_svc_threshold = (
             ray_config().pipeline_service_threshold_s)
+        self._lease_batching = batching
+        self._lease_batch_max = max(1, ray_config().lease_batch_max)
         self.lease_requests = 0
+        self.grant_cap = grant_cap   # raylet-side per-RPC grant limit
         self.fail_first = fail_first
         self.returned = []
 
-    async def _request_lease(self, resources, is_actor=False, bundle=None,
-                             address=None):
+    def _grant(self):
         self.lease_requests += 1
         if self.lease_requests <= self.fail_first:
             raise OSError(f"raylet down (simulated #{self.lease_requests})")
@@ -40,6 +43,18 @@ class _Harness(ClusterRuntime):
                 "worker_id": f"wid{self.lease_requests}",
                 "lease_id": f"l{self.lease_requests}",
                 "raylet_address": "raylet:1"}
+
+    async def _request_lease(self, resources, is_actor=False, bundle=None,
+                             address=None):
+        return self._grant()
+
+    async def _request_leases(self, resources, n, bundle=None,
+                              address=None):
+        self.lease_rpcs = getattr(self, "lease_rpcs", 0) + 1
+        if self.grant_cap:
+            n = min(n, self.grant_cap)   # partial grant
+        first = self._grant()            # a fault fails the whole RPC
+        return [first] + [self._grant() for _ in range(n - 1)]
 
     async def _return_worker(self, worker, dead=False):
         self.returned.append((worker["lease_id"], dead))

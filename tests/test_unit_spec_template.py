@@ -99,7 +99,7 @@ def test_repeated_submits_share_one_template():
     rt = _harness()
     specs = []
     for i in range(3):
-        spec, sk = rt._encode_task_spec(
+        spec, sk, _tm = rt._encode_task_spec(
             _FakeFn, _opts(), "fnkey", 1, False,
             task_id=f"{i:02x}" * 16, args=b"a", arg_oids=[],
             trace_ctx=None)
@@ -123,7 +123,7 @@ def test_option_change_invalidates_template(change):
     rt._encode_task_spec(_FakeFn, _opts(), "fnkey", 1, False,
                          task_id="aa" * 16, args=b"a", arg_oids=[],
                          trace_ctx=None)
-    spec2, _ = rt._encode_task_spec(
+    spec2, _, _ = rt._encode_task_spec(
         _FakeFn, _opts(**change), "fnkey", 1, False,
         task_id="bb" * 16, args=b"a", arg_oids=[], trace_ctx=None)
     assert len(rt._spec_templates) == 2   # miss -> fresh prototype
@@ -139,11 +139,11 @@ def test_runtime_env_change_changes_scheduling_key():
     # Distinct runtime envs must never share a leased worker: the env
     # rides the scheduling key (worker-compatibility class).
     rt = _harness()
-    _, sk_a = rt._encode_task_spec(
+    _, sk_a, _ = rt._encode_task_spec(
         _FakeFn, _opts(runtime_env={"env_vars": {"A": "1"}}), "fnkey",
         1, False, task_id="aa" * 16, args=b"", arg_oids=[],
         trace_ctx=None)
-    _, sk_b = rt._encode_task_spec(
+    _, sk_b, _ = rt._encode_task_spec(
         _FakeFn, _opts(runtime_env={"env_vars": {"A": "2"}}), "fnkey",
         1, False, task_id="bb" * 16, args=b"", arg_oids=[],
         trace_ctx=None)
