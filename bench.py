@@ -99,6 +99,18 @@ def main() -> None:
     except Exception:
         pass
     try:
+        # LLM-serving scenario (continuous-batching engine): sustained
+        # tokens/s vs the static-batching baseline on the same mixed
+        # workload, TTFT, and shed-mode p99 under 2x overload — the
+        # north-star serving metrics next to the training headline.
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.perf", "--llm-serve"],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        notes["llm_serve"] = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        notes["llm_serve_error"] = repr(e)
+    try:
         out = subprocess.run(
             [sys.executable, "-m", "ray_tpu.rllib.bench"],
             capture_output=True, text=True, timeout=300,

@@ -424,6 +424,45 @@ class Raylet:
                 })
         return entries
 
+    async def handle_get_worker_logs(self, conn: ServerConnection, *,
+                                     worker: Optional[str] = None,
+                                     tail_bytes: int = 16384
+                                     ) -> List[Dict[str, Any]]:
+        """Log aggregation read path (dashboard `/api/logs`): the tail
+        of each worker's log file on THIS node, newest bytes first cut
+        to whole lines. `worker` filters by worker-id prefix. Distinct
+        from the streaming monitor: this reads on demand from offset
+        zero of the tail, so lines already shipped to drivers are still
+        inspectable."""
+        out: List[Dict[str, Any]] = []
+        budget = max(1024, min(int(tail_bytes), 1 << 20))
+        for w in list(self._workers.values()):
+            if worker and not w.worker_id.startswith(worker):
+                continue
+            if not w.log_path:
+                continue
+            try:
+                size = os.path.getsize(w.log_path)
+                with open(w.log_path, "rb") as f:
+                    f.seek(max(0, size - budget))
+                    chunk = f.read(budget)
+            except OSError:
+                continue
+            if size > budget:
+                # Drop the partial first line of a mid-file seek.
+                cut = chunk.find(b"\n")
+                chunk = chunk[cut + 1:] if cut >= 0 else chunk
+            out.append({
+                "node_id": self.node_id,
+                "worker_id": w.worker_id,
+                "pid": w.proc.pid,
+                "actor_id": w.actor_id,
+                "job_id": w.actor_job_id or w.lease_job_id,
+                "path": w.log_path,
+                "lines": chunk.decode("utf-8", "replace").splitlines(),
+            })
+        return out
+
     async def _log_monitor_loop(self) -> None:
         interval = ray_config().log_monitor_interval_s
         while True:

@@ -17,6 +17,7 @@ Endpoints:
   GET /api/tasks?job_id=...    task events
   GET /api/serve               per-deployment QPS/latency/queue state
   GET /api/train               per-trial step-time telemetry
+  GET /api/logs?node=&worker=  per-worker log tails (id-prefix filters)
   GET /metrics                 Prometheus text: all nodes + app metrics
   GET /                        tiny HTML index
 
@@ -46,6 +47,7 @@ _INDEX_HTML = """<!doctype html>
 <li><a href=/api/cluster_status>cluster status</a>
 <li><a href=/api/serve>serve deployments</a>
 <li><a href=/api/train>train telemetry</a>
+<li><a href=/api/logs>worker logs</a>
 <li><a href=/metrics>metrics (prometheus)</a>
 </ul>
 """
@@ -161,6 +163,12 @@ class DashboardHead:
             return await self._serve_state()
         if endpoint == "train":
             return await self._train_state()
+        if endpoint == "logs":
+            return await self._logs(
+                node=query.get("node", [None])[0],
+                worker=query.get("worker", [None])[0],
+                tail_bytes=int(query.get("tail_bytes",
+                                         ["16384"])[0]))
         return None
 
     async def _raylet(self, address: str):
@@ -192,11 +200,15 @@ class DashboardHead:
             await self._drop_raylet(node["address"])
             return {"node_id": node.get("node_id"), "error": str(exc)}
 
-    async def _per_node(self, rpc: str, **kwargs) -> list:
+    async def _per_node(self, rpc: str, node_prefix: Optional[str] = None,
+                        **kwargs) -> list:
         # Concurrent fan-out: one hung node must not stall the endpoint
-        # for the healthy rest.
+        # for the healthy rest. `node_prefix` narrows to nodes whose id
+        # starts with it (the /api/logs?node=… filter).
         nodes = [n for n in await self._gcs.get_nodes()
-                 if n.get("alive", True)]
+                 if n.get("alive", True)
+                 and (not node_prefix or str(
+                     n.get("node_id", "")).startswith(node_prefix))]
         return list(await asyncio.gather(
             *(self._scrape_node(n, rpc, **kwargs) for n in nodes)))
 
@@ -325,6 +337,25 @@ class DashboardHead:
         for s in m.get("train_gang_workers", []):
             slot(s["tags"].get("trial", "?"))["workers"] = s["value"]
         return {"trials": trials}
+
+    async def _logs(self, node: Optional[str] = None,
+                    worker: Optional[str] = None,
+                    tail_bytes: int = 16384) -> list:
+        """Aggregate per-worker log tails across the cluster
+        (`/api/logs?node=<id prefix>&worker=<id prefix>`): each raylet
+        serves its workers' file tails over `get_worker_logs`; the
+        dashboard fans out and merges — one place to read any worker's
+        output without shelling into nodes."""
+        results = await self._per_node("get_worker_logs",
+                                       node_prefix=node, worker=worker,
+                                       tail_bytes=tail_bytes)
+        merged: list = []
+        for r in results:
+            if isinstance(r, list):
+                merged.extend(r)
+            elif isinstance(r, dict):   # scrape error marker
+                merged.append(r)
+        return merged
 
     async def _metrics(self) -> str:
         from ray_tpu.util.metrics import merge_snapshots, render_prometheus

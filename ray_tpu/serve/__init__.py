@@ -44,6 +44,7 @@ def __getattr__(name):
 __all__ = [
     "deployment", "run", "delete", "shutdown", "status",
     "get_app_handle", "get_deployment_handle", "batch",
+    "configure_proxy_admission", "proxy_admission_stats",
     "multiplexed", "get_multiplexed_model_id", "start_grpc_ingress",
     "GrpcServeClient", "compile_deployment_chain",
     "Deployment", "Application", "DeploymentHandle",
@@ -216,7 +217,7 @@ def start(http_options: Optional[HTTPOptions] = None) -> int:
         opts = http_options or HTTPOptions(port=0)
         actor_cls = ray_tpu.remote(num_cpus=0, name=_PROXY_NAME,
                                    max_concurrency=64)(HTTPProxy)
-        proxy = actor_cls.remote(controller, opts.host, opts.port)
+        proxy = actor_cls.remote(controller, opts.host, opts.port, opts)
         _http_port = ray_tpu.get(proxy.start.remote(), timeout=60)
     if _http_port is None:
         _http_port = ray_tpu.get(proxy.ready.remote(), timeout=60)
@@ -263,6 +264,30 @@ def start_grpc_ingress(port: int = 0, host: str = "127.0.0.1",
     if _grpc_port is None:
         _grpc_port = ray_tpu.get(proxy.start.remote(), timeout=60)
     return _grpc_port
+
+
+def configure_proxy_admission(max_inflight: Optional[int] = None,
+                              rate: Optional[float] = None,
+                              burst: int = 16) -> bool:
+    """(Re)configure the HTTP ingress overload gate at runtime: an
+    in-flight cap (excess answers 503 before any work is queued) and a
+    token-bucket rate limit (429). `None` disables a gate. Sheds are
+    counted in `serve_engine_shed_requests`."""
+    import ray_tpu
+
+    start()
+    proxy = ray_tpu.get_actor(_PROXY_NAME)
+    return ray_tpu.get(proxy.configure_admission.remote(
+        max_inflight, rate, burst), timeout=30)
+
+
+def proxy_admission_stats() -> Dict[str, Any]:
+    """Current gate state + shed counts from the HTTP ingress."""
+    import ray_tpu
+
+    start()   # same contract as configure_proxy_admission
+    proxy = ray_tpu.get_actor(_PROXY_NAME)
+    return ray_tpu.get(proxy.admission_stats.remote(), timeout=30)
 
 
 def run(app: Application, *, name: str = "default",
@@ -438,6 +463,11 @@ class _BatchQueue:
         self._items: List[Any] = []
         self._futures: List[asyncio.Future] = []
         self._flusher: Optional[asyncio.Task] = None
+        # Strong refs to in-flight batch tasks: the event loop only
+        # keeps WEAK references to tasks, so a flush fired for waiters
+        # whose callers were since cancelled could be garbage-collected
+        # mid-run — dropping the whole batch on the floor.
+        self._tasks: set = set()
 
     async def submit(self, owner, item):
         fut = asyncio.get_running_loop().create_future()
@@ -446,8 +476,15 @@ class _BatchQueue:
         if len(self._items) >= self._max:
             self._flush_now(owner)
         elif self._flusher is None or self._flusher.done():
+            # The flusher is an INDEPENDENT task, deliberately not
+            # awaited by this submit: cancelling the first awaiter must
+            # not cancel the timer the rest of the batch relies on.
             self._flusher = asyncio.get_running_loop().create_task(
                 self._delayed_flush(owner))
+        # If the caller is cancelled here, its slot still flushes with
+        # the batch (results land on a done future harmlessly) and the
+        # INDEPENDENT flusher task keeps ticking for the rest —
+        # regression-tested in test_unit_serve_batching.py.
         return await fut
 
     async def _delayed_flush(self, owner):
@@ -459,8 +496,10 @@ class _BatchQueue:
             return
         items, futures = self._items, self._futures
         self._items, self._futures = [], []
-        asyncio.get_running_loop().create_task(
+        task = asyncio.get_running_loop().create_task(
             self._run_batch(owner, items, futures))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
 
     async def _run_batch(self, owner, items, futures) -> None:
         try:
@@ -473,6 +512,8 @@ class _BatchQueue:
                 if not fut.done():
                     fut.set_result(out)
         except BaseException as e:  # noqa: BLE001
+            # One failure rejects EVERY waiter of this batch: each
+            # caller sees the batched fn's exception, not a hang.
             for fut in futures:
                 if not fut.done():
                     fut.set_exception(e)

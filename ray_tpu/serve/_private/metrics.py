@@ -90,6 +90,49 @@ def queued_delta(deployment: str, delta: int) -> None:
             pass  # metrics must never fail the data path
 
 
+def engine_metrics() -> Dict[str, Any]:
+    """Continuous-batching engine + overload-shedding instruments
+    (`serve_engine_*`). The engine gauges live in the replica process
+    hosting the `InferenceEngine`; the shed counter lives in the proxy
+    process (sheds happen BEFORE work is queued, so the ingress is the
+    only place that can count them)."""
+    def build():
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        return {
+            "batch_occupancy": Gauge(
+                "serve_engine_batch_occupancy",
+                "Sequences in the engine's running decode batch"),
+            "cache_utilization": Gauge(
+                "serve_engine_cache_utilization",
+                "Fraction of KV-cache blocks allocated"),
+            "queue_depth": Gauge(
+                "serve_engine_queue_depth",
+                "Requests waiting for engine admission"),
+            "preemptions": Counter(
+                "serve_engine_preemptions",
+                "Sequences preempted (blocks freed, requeued) under "
+                "cache pressure"),
+            "tokens": Counter(
+                "serve_engine_tokens_generated",
+                "Tokens generated across all sequences"),
+            "step_phase": Counter(
+                "serve_engine_step_seconds",
+                "Cumulative model time split by phase",
+                tag_keys=("phase",)),     # prefill | decode
+            "shed": Counter(
+                "serve_engine_shed_requests",
+                "Requests shed at the ingress before queuing",
+                tag_keys=("status",)),    # 429 | 503
+            "ttft": Histogram(
+                "serve_engine_time_to_first_token_seconds",
+                "Submit-to-first-token latency",
+                boundaries=_LATENCY_BOUNDARIES),
+        }
+
+    return _component("engine", build)
+
+
 def replica_metrics() -> Dict[str, Any]:
     """Replica-side instruments (the user-code execution edge)."""
     def build():

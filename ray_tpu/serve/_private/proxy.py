@@ -6,19 +6,74 @@ actor's event loop. Requests route by longest matching route prefix to a
 DeploymentHandle; responses are JSON (dict/list returns), raw bytes, or
 text. The proxy refreshes its route table from the controller
 periodically, so `serve.run` of a new app is picked up without restarts.
+
+Two additions for LLM-style serving:
+
+- **admission control / load shedding** (`_AdmissionGate`): a token
+  bucket (429) plus an in-flight cap (503) evaluated BEFORE any work is
+  dispatched, so under 2x overload excess requests bounce in
+  microseconds instead of stacking an unbounded queue behind the
+  replicas — served-request p99 stays bounded. Sheds are counted in
+  `serve_engine_shed_requests` by status.
+- **streaming responses**: a request carrying `Accept:
+  text/event-stream` (or `?stream=1`) routes through the streaming
+  handle path and writes chunked transfer encoding, one chunk per
+  yielded item — time-to-first-byte decouples from generation length.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import time
 from typing import Any, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
 
+_STREAM_END = object()
+
+
+class _AdmissionGate:
+    """Pre-queue overload gate: in-flight cap first (503 — the system
+    is saturated; retry against another ingress), then a token bucket
+    (429 — the client is over its rate)."""
+
+    def __init__(self, max_inflight: Optional[int] = None,
+                 rate: Optional[float] = None, burst: int = 16):
+        self.configure(max_inflight, rate, burst)
+        self.shed_503 = 0
+        self.shed_429 = 0
+
+    def configure(self, max_inflight: Optional[int],
+                  rate: Optional[float], burst: int = 16) -> None:
+        self.max_inflight = max_inflight
+        self.rate = rate
+        self.burst = max(1, int(burst))
+        self._tokens = float(self.burst)
+        self._last = time.monotonic()
+
+    def check(self, inflight: int) -> Optional[str]:
+        """None = admit; otherwise the shed status ("503" | "429")."""
+        if self.max_inflight is not None \
+                and inflight >= self.max_inflight:
+            self.shed_503 += 1
+            return "503"
+        if self.rate is not None:
+            now = time.monotonic()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last)
+                               * self.rate)
+            self._last = now
+            if self._tokens < 1.0:
+                self.shed_429 += 1
+                return "429"
+            self._tokens -= 1.0
+        return None
+
+
 class HTTPProxy:
     def __init__(self, controller_handle, host: str = "127.0.0.1",
-                 port: int = 8000):
+                 port: int = 8000, http_options=None):
         self._controller = controller_handle
         self.host = host
         self.port = port
@@ -26,6 +81,51 @@ class HTTPProxy:
         self._handles: Dict[str, Any] = {}
         self._routes: Dict[str, str] = {}
         self._route_task = None
+        self._inflight = 0
+        opts = http_options
+        self._gate = _AdmissionGate(
+            getattr(opts, "max_inflight_requests", None),
+            getattr(opts, "admission_rate_limit", None),
+            getattr(opts, "admission_burst", 16) or 16)
+        # Dedicated pump pool for streaming responses: each active SSE
+        # stream parks a thread in next() between tokens, and the
+        # loop's DEFAULT executor is tiny (cpus+4) and shared with the
+        # non-streaming dispatch path — a handful of slow streams must
+        # not stall the whole ingress.
+        import concurrent.futures
+
+        self._stream_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="sse-pump")
+
+    # -- admission control --------------------------------------------
+    def configure_admission(self, max_inflight: Optional[int] = None,
+                            rate: Optional[float] = None,
+                            burst: int = 16) -> bool:
+        """Reconfigure the shedding gate at runtime (tests, operators)."""
+        self._gate.configure(max_inflight, rate, burst)
+        return True
+
+    def admission_stats(self) -> Dict[str, Any]:
+        return {"inflight": self._inflight,
+                "shed_503": self._gate.shed_503,
+                "shed_429": self._gate.shed_429,
+                "max_inflight": self._gate.max_inflight,
+                "rate": self._gate.rate}
+
+    def _count_shed(self, status: str, metrics) -> None:
+        try:
+            from ray_tpu.serve._private.metrics import engine_metrics
+
+            engine_metrics()["shed"].inc(1, tags={"status": status})
+        except Exception:
+            pass
+        if metrics is not None:
+            try:
+                metrics["requests"].inc(1, tags={
+                    "ingress": "http", "route": "shed",
+                    "status": f"shed_{status}"})
+            except Exception:
+                pass
 
     async def start(self) -> int:
         """Bind and serve; returns the bound port (0 → ephemeral)."""
@@ -91,7 +191,10 @@ class HTTPProxy:
                 request = await self._read_request(reader)
                 if request is None:
                     break
-                status, body, ctype = await self._dispatch(request)
+                out = await self._dispatch(request, writer)
+                if out is None:
+                    continue  # streaming path wrote its own response
+                status, body, ctype = out
                 writer.write(
                     b"HTTP/1.1 " + status + b"\r\n"
                     b"Content-Type: " + ctype + b"\r\n"
@@ -132,9 +235,13 @@ class HTTPProxy:
                           parse_qs(parsed.query).items()},
                 "headers": headers, "body": body}
 
-    async def _dispatch(self, request: dict):
-        import time
+    @staticmethod
+    def _wants_stream(request: dict) -> bool:
+        accept = request["headers"].get("accept", "")
+        return ("text/event-stream" in accept
+                or request["query"].get("stream") in ("1", "true"))
 
+    async def _dispatch(self, request: dict, writer=None):
         from ray_tpu.serve._private.metrics import proxy_metrics
         from ray_tpu.util.tracing import span
 
@@ -171,8 +278,26 @@ class HTTPProxy:
         if deployment is None:
             _count("not_found")
             return b"404 Not Found", b"no route", b"text/plain"
+
+        # Overload gate BEFORE any work is dispatched or queued: the
+        # whole point of shedding at the edge is that an over-capacity
+        # request costs microseconds, not a queue slot.
+        shed = self._gate.check(self._inflight)
+        if shed is not None:
+            self._count_shed(shed, metrics)
+            if shed == "429":
+                return (b"429 Too Many Requests",
+                        b"rate limited; retry later", b"text/plain")
+            return (b"503 Service Unavailable",
+                    b"overloaded; retry later", b"text/plain")
+
         handle = self._handle_for(deployment)
+        if writer is not None and self._wants_stream(request):
+            return await self._dispatch_streaming(
+                request, writer, deployment, handle, metrics,
+                route_tag)
         t0 = time.perf_counter()
+        self._inflight += 1
         try:
             # The ingress span honors an inbound W3C `traceparent` header
             # (external tracer continuity); the router/replica spans nest
@@ -193,6 +318,7 @@ class HTTPProxy:
             return (b"500 Internal Server Error",
                     f"{type(e).__name__}: {e}".encode(), b"text/plain")
         finally:
+            self._inflight -= 1
             if metrics is not None:
                 try:
                     metrics["latency"].observe(
@@ -208,14 +334,121 @@ class HTTPProxy:
             return b"200 OK", value, b"application/octet-stream"
         return b"200 OK", str(value).encode(), b"text/plain"
 
-    def _call_blocking(self, handle, request: dict):
+    async def _dispatch_streaming(self, request: dict, writer,
+                                  deployment: str, handle, metrics,
+                                  route_tag: str) -> None:
+        """Chunked-transfer streaming: one HTTP chunk per item the
+        replica's generator yields, flushed immediately — the client
+        sees the first token while generation continues. SSE-framed
+        (`data: <json>\\n\\n`) under text/event-stream. Returns None:
+        the response is fully written here."""
+        from ray_tpu.util.tracing import span
+
+        t0 = time.perf_counter()
+        self._inflight += 1
+        status = "ok"
+        headers_sent = False
+        try:
+            with span("serve.proxy",
+                      parent=request["headers"].get("traceparent"),
+                      attributes={"ingress": "http",
+                                  "route": request["path"],
+                                  "deployment": deployment,
+                                  "method": request["method"],
+                                  "component": "proxy",
+                                  "streaming": "1"}):
+                loop = asyncio.get_running_loop()
+                payload, method_name = self._request_payload(request)
+                # Routing blocks (table refresh RPCs): keep the proxy
+                # loop free, same as the non-streaming path.
+                gen = await loop.run_in_executor(
+                    self._stream_pool,
+                    lambda: handle.options(
+                        stream=True,
+                        method_name=method_name or "__call__",
+                    ).remote(payload))
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\n"
+                    b"Content-Type: text/event-stream\r\n"
+                    b"Cache-Control: no-cache\r\n"
+                    b"Transfer-Encoding: chunked\r\n"
+                    b"Connection: keep-alive\r\n\r\n")
+                headers_sent = True
+                await writer.drain()
+                it = iter(gen)
+                while True:
+                    # StopIteration cannot cross a Future boundary
+                    # (asyncio converts it to a RuntimeError mid-loop);
+                    # a sentinel can.
+                    item = await loop.run_in_executor(
+                        self._stream_pool, next, it, _STREAM_END)
+                    if item is _STREAM_END:
+                        break
+                    chunk = (b"data: " + json.dumps(
+                        item, default=str).encode() + b"\n\n")
+                    writer.write(hex(len(chunk))[2:].encode()
+                                 + b"\r\n" + chunk + b"\r\n")
+                    await writer.drain()
+                writer.write(b"0\r\n\r\n")
+                await writer.drain()
+        except Exception as e:  # noqa: BLE001
+            status = "error"
+            try:
+                if not headers_sent:
+                    # Nothing on the wire yet: a plain error response.
+                    body = f"{type(e).__name__}: {e}".encode()
+                    writer.write(
+                        b"HTTP/1.1 500 Internal Server Error\r\n"
+                        b"Content-Type: text/plain\r\n"
+                        b"Content-Length: "
+                        + str(len(body)).encode() + b"\r\n"
+                        b"Connection: keep-alive\r\n\r\n" + body)
+                else:
+                    # Mid-stream failures can't change the status line;
+                    # surface as a terminal SSE event + end-of-chunks so
+                    # clients see a clean close, not a hung connection.
+                    chunk = (b"event: error\ndata: "
+                             + f"{type(e).__name__}: {e}".encode()
+                             + b"\n\n")
+                    writer.write(hex(len(chunk))[2:].encode() + b"\r\n"
+                                 + chunk + b"\r\n0\r\n\r\n")
+                await writer.drain()
+            except Exception:
+                pass
+        finally:
+            self._inflight -= 1
+            if metrics is not None:
+                try:
+                    metrics["requests"].inc(1, tags={
+                        "ingress": "http", "route": route_tag,
+                        "status": status})
+                    metrics["latency"].observe(
+                        time.perf_counter() - t0,
+                        tags={"ingress": "http", "route": route_tag})
+                except Exception:
+                    pass
+        return None
+
+    @staticmethod
+    def _request_payload(request: dict):
+        """Extract (payload, method_name) — shared by the blocking and
+        streaming paths. JSON bodies become the payload; a `method`
+        query arg targets a named deployment method."""
         body = request["body"]
         payload: Any = request
         ctype = request["headers"].get("content-type", "")
+        query = {k: v for k, v in request["query"].items()
+                 if k not in ("stream", "method")}  # proxy-level params
         if body and "application/json" in ctype:
             payload = json.loads(body)
-        elif not body and request["query"]:
-            payload = request["query"]
+        elif not body and query:
+            payload = query
+        return payload, request["query"].get("method")
+
+    def _call_blocking(self, handle, request: dict):
+        payload, method_name = self._request_payload(request)
+        if method_name:
+            handle = handle.options(method_name=method_name)
         return handle.remote(payload).result(timeout_s=60)
 
     async def ready(self) -> int:

@@ -9,6 +9,7 @@ updates drop nothing.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import inspect
 import time
 from typing import Any, Dict, Optional, Tuple
@@ -59,56 +60,59 @@ class Replica:
                 "replica": self.replica_id}
 
     # -- data plane ----------------------------------------------------
-    async def handle_request(self, method_name: str, args: Tuple,
-                             kwargs: Dict,
-                             metadata: Optional[Dict] = None) -> Any:
+    @contextlib.contextmanager
+    def _request_scope(self, method_name: str,
+                       metadata: Optional[Dict],
+                       streaming: bool = False):
+        """Shared per-request bookkeeping for BOTH data-plane entry
+        points: drain gate, ongoing/total counters, multiplex model-id
+        context + loan scope, replica metrics, and the `serve.replica`
+        span (explicit parent: async actor methods execute on the actor
+        loop OUTSIDE the worker's task-execution span context, so the
+        proxy/router trace must ride the request metadata)."""
         if self._draining:
             from ray_tpu.serve.exceptions import ReplicaDrainingError
 
             raise ReplicaDrainingError(
                 f"replica {self.replica_id} is draining")
         from ray_tpu.serve._private.metrics import replica_metrics
+        from ray_tpu.serve.multiplex import (_begin_request_loans,
+                                             _end_request_loans,
+                                             _set_request_model_id)
         from ray_tpu.util.tracing import span
 
         self._ongoing += 1
         self._total += 1
         token = None
         if metadata and metadata.get("multiplexed_model_id"):
-            from ray_tpu.serve.multiplex import _set_request_model_id
-
             token = _set_request_model_id(
                 metadata["multiplexed_model_id"])
+        loan_scope = _begin_request_loans()
         try:
             metrics = replica_metrics()
-            tags = self._metric_tags()
-            metrics["ongoing"].set(self._ongoing, tags=tags)
+            metrics["ongoing"].set(self._ongoing,
+                                   tags=self._metric_tags())
         except Exception:
             metrics = None
         status = "ok"
         t0 = time.perf_counter()
+        attributes = {"deployment": self.deployment_name,
+                      "replica": self.replica_id,
+                      "method": method_name,
+                      "component": "replica"}
+        if streaming:
+            attributes["streaming"] = "1"
         try:
-            # Explicit parent: async actor methods execute on the actor
-            # loop OUTSIDE the worker's task-execution span context, so
-            # the proxy/router trace must ride the request metadata.
             with span("serve.replica",
                       parent=(metadata or {}).get("traceparent"),
-                      attributes={"deployment": self.deployment_name,
-                                  "replica": self.replica_id,
-                                  "method": method_name,
-                                  "component": "replica"}):
-                target = (self._instance if method_name == "__call__"
-                          else None)
-                method = (getattr(self._instance, method_name)
-                          if target is None else self._resolve_call())
-                if inspect.iscoroutinefunction(method):
-                    return await method(*args, **kwargs)
-                # Sync user code must not block the replica's event loop.
-                return await asyncio.to_thread(method, *args, **kwargs)
+                      attributes=attributes):
+                yield
         except BaseException:
             status = "error"
             raise
         finally:
             self._ongoing -= 1
+            _end_request_loans(loan_scope)
             if metrics is not None:
                 try:
                     metrics["processed"].inc(
@@ -124,6 +128,64 @@ class Replica:
                 from ray_tpu.serve.multiplex import _request_model_id
 
                 _request_model_id.reset(token)
+
+    def _resolve_method(self, method_name: str):
+        return (self._resolve_call() if method_name == "__call__"
+                else getattr(self._instance, method_name))
+
+    async def handle_request(self, method_name: str, args: Tuple,
+                             kwargs: Dict,
+                             metadata: Optional[Dict] = None) -> Any:
+        with self._request_scope(method_name, metadata):
+            method = self._resolve_method(method_name)
+            if inspect.iscoroutinefunction(method):
+                return await method(*args, **kwargs)
+            # Sync user code must not block the replica's event loop.
+            return await asyncio.to_thread(method, *args, **kwargs)
+
+    def handle_request_streaming(self, method_name: str, args: Tuple,
+                                 kwargs: Dict,
+                                 metadata: Optional[Dict] = None):
+        """Streaming data plane: a SYNC generator the runtime executes
+        as a streaming actor task (`num_returns="streaming"`) — each
+        yielded item becomes one ObjectRef pushed to the caller while
+        generation continues, so time-to-first-token decouples from
+        completion. User methods may be sync generators, async
+        generators (pumped on a private loop — the executor thread that
+        runs this has no ambient loop), or coroutines/callables whose
+        return streams element-wise when iterable (str/bytes/dict count
+        as ONE item)."""
+        with self._request_scope(method_name, metadata, streaming=True):
+            method = self._resolve_method(method_name)
+            out = method(*args, **kwargs)
+            yield from self._iterate_result(out)
+
+    @staticmethod
+    def _iterate_result(out):
+        """Flatten any user return shape into a sync item stream."""
+        import asyncio as _asyncio
+
+        if inspect.iscoroutine(out):
+            out = _asyncio.run(out)
+        if inspect.isasyncgen(out):
+            # Pump the async generator on a private loop owned by this
+            # (executor) thread; each item crosses back synchronously.
+            loop = _asyncio.new_event_loop()
+            try:
+                while True:
+                    try:
+                        yield loop.run_until_complete(out.__anext__())
+                    except StopAsyncIteration:
+                        break
+            finally:
+                loop.run_until_complete(out.aclose())
+                loop.close()
+        elif inspect.isgenerator(out) or (
+                not isinstance(out, (str, bytes, dict))
+                and hasattr(out, "__iter__")):
+            yield from out
+        else:
+            yield out
 
     def _resolve_call(self):
         call = getattr(self._instance, "__call__", None)

@@ -127,7 +127,8 @@ class Router:
 
     def assign(self, method_name: str, args: tuple, kwargs: dict,
                timeout_s: float = 30.0,
-               model_id: Optional[str] = None):
+               model_id: Optional[str] = None,
+               streaming: bool = False):
         """Pick a replica and submit; returns (replica_id, ObjectRef).
         Blocks (with backoff) while the deployment has no running
         replica — e.g. mid-startup.
@@ -190,7 +191,18 @@ class Router:
             if traceparent:
                 metadata = dict(metadata or {})
                 metadata["traceparent"] = traceparent
-            if metadata is not None:
+            if streaming:
+                # Streaming actor task: the replica's sync-generator
+                # entrypoint yields one ObjectRef per item to the
+                # returned ObjectRefGenerator while it runs.
+                method = handle.handle_request_streaming.options(
+                    num_returns="streaming")
+                if metadata is not None:
+                    ref = method.remote(method_name, args, kwargs,
+                                        metadata)
+                else:
+                    ref = method.remote(method_name, args, kwargs)
+            elif metadata is not None:
                 ref = handle.handle_request.remote(method_name, args,
                                                    kwargs, metadata)
             else:

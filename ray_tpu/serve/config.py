@@ -41,3 +41,14 @@ class DeploymentConfig:
 class HTTPOptions:
     host: str = "127.0.0.1"
     port: int = 8000
+    # Admission control / load shedding at the ingress (reference:
+    # serve's request_timeout + the Orca/vLLM-era practice of shedding
+    # BEFORE queuing so p99 under overload stays bounded):
+    # - max_inflight_requests: hard cap on concurrently-dispatched
+    #   requests; beyond it the proxy answers 503 immediately (queue
+    #   depth IS the overload signal — work is never buffered).
+    # - admission_rate_limit/admission_burst: token bucket (requests/s,
+    #   bucket size); exceeding it answers 429. None disables a gate.
+    max_inflight_requests: Optional[int] = None
+    admission_rate_limit: Optional[float] = None
+    admission_burst: int = 16

@@ -1,0 +1,51 @@
+"""Continuous-batching LLM inference engine for Serve replicas.
+
+The two techniques that turn a batch-serving layer into an LLM-serving
+layer, composed into one loop that runs inside a Serve replica:
+
+- **iteration-level scheduling** (Orca, Yu et al. OSDI'22): admission,
+  retirement and preemption decisions happen between every decode step
+  — `scheduler.InferenceEngine`;
+- **block-granular KV-cache management** (vLLM, Kwon et al. SOSP'23):
+  fixed-size blocks in one preallocated buffer with per-sequence block
+  tables — `kv_cache.KVCacheManager`.
+
+Typical replica:
+
+    from ray_tpu import serve
+    from ray_tpu.serve.engine import (EngineConfig, InferenceEngine,
+                                      TinyLM)
+
+    @serve.deployment
+    class LLM:
+        def __init__(self):
+            self.engine = InferenceEngine(TinyLM(), EngineConfig())
+            self.engine.start()
+
+        def generate(self, prompt, max_new_tokens=32):
+            # Sync generator: streams over the handle
+            # (`handle.options(stream=True)`) and the HTTP proxy's
+            # chunked path.
+            stream = self.engine.submit(prompt, max_new_tokens)
+            for tok in stream:
+                yield tok
+
+        async def __call__(self, req):
+            stream = self.engine.submit(req["prompt"],
+                                        req.get("max_new_tokens"))
+            return [tok async for tok in stream]
+"""
+
+from ray_tpu.serve.engine.kv_cache import (CacheOverflowError,
+                                           KVCacheManager)
+from ray_tpu.serve.engine.model import TinyLM, TransformerEngineModel
+from ray_tpu.serve.engine.scheduler import (EngineConfig,
+                                            EngineOverloadedError,
+                                            EngineStoppedError,
+                                            InferenceEngine, TokenStream)
+
+__all__ = [
+    "CacheOverflowError", "EngineConfig", "EngineOverloadedError",
+    "EngineStoppedError", "InferenceEngine", "KVCacheManager", "TinyLM",
+    "TokenStream", "TransformerEngineModel",
+]

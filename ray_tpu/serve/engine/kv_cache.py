@@ -1,0 +1,199 @@
+"""Block-granular KV-cache manager (the vLLM PagedAttention idea).
+
+Reference: Kwon et al., "Efficient Memory Management for Large Language
+Model Serving with PagedAttention" (SOSP'23) — the KV cache is carved
+into fixed-size blocks in ONE preallocated buffer; each sequence owns an
+ordered block list instead of a contiguous max-length slab, so cache
+memory is committed token-by-token and freed the moment a sequence
+retires. Fragmentation is bounded to less than one block per sequence,
+and admission/preemption decisions reduce to free-block arithmetic.
+
+The manager owns two things:
+
+- **accounting**: the free-block list, per-sequence block tables and
+  written lengths — `can_allocate` / `allocate` / `free` are what the
+  iteration scheduler calls between decode steps;
+- **storage**: the preallocated `[num_blocks, block_size, *kv_shape]`
+  buffer itself, with `write` / `write_range` / `gather` translating
+  logical token positions through the block table. The buffer namespace
+  is pluggable: numpy (default — zero-copy views, exact, fast under
+  `JAX_PLATFORMS=cpu`) or `jax.numpy` (device-resident cache; writes go
+  through `.at[].set`, which XLA performs in place when the buffer is
+  not aliased).
+
+Determinism contract (the scheduler's loop must never crash on OOM):
+`allocate` is atomic — it either extends the table to cover the request
+or changes nothing and returns False; the scheduler converts False into
+preempt-and-requeue of the lowest-priority sequence.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class CacheOverflowError(RuntimeError):
+    """A single sequence needs more tokens than the whole cache holds —
+    the one OOM shape that cannot be fixed by preempting someone else."""
+
+
+class KVCacheManager:
+    """Fixed-size blocks in one preallocated buffer + per-sequence block
+    tables. Thread-safe (the engine loop and `stats()` callers race)."""
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 kv_shape: Tuple[int, ...] = (), dtype=np.float32,
+                 array_ns=None):
+        if num_blocks <= 0 or block_size <= 0:
+            raise ValueError("num_blocks and block_size must be positive")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.kv_shape = tuple(kv_shape)
+        self._ns = array_ns if array_ns is not None else np
+        # THE preallocated cache: every sequence's KV lives here.
+        self._buffer = self._ns.zeros(
+            (self.num_blocks, self.block_size) + self.kv_shape, dtype)
+        # LIFO free list: recently-freed blocks are cache-warm.
+        self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+        self._tables: Dict[str, List[int]] = {}
+        self._lens: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- accounting ----------------------------------------------------
+    @property
+    def capacity_tokens(self) -> int:
+        return self.num_blocks * self.block_size
+
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def utilization(self) -> float:
+        """Fraction of blocks allocated (the `cache_utilization` gauge)."""
+        with self._lock:
+            return 1.0 - len(self._free) / self.num_blocks
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return max(0, math.ceil(n_tokens / self.block_size))
+
+    def seq_len(self, seq_id: str) -> int:
+        with self._lock:
+            return self._lens.get(seq_id, 0)
+
+    def block_table(self, seq_id: str) -> List[int]:
+        with self._lock:
+            return list(self._tables.get(seq_id, ()))
+
+    def can_allocate(self, seq_id: str, target_tokens: int) -> bool:
+        """Would `allocate(seq_id, target_tokens)` succeed right now?"""
+        with self._lock:
+            return self._deficit(seq_id, target_tokens) <= len(self._free)
+
+    def _deficit(self, seq_id: str, target_tokens: int) -> int:
+        have = len(self._tables.get(seq_id, ()))
+        need = self.blocks_for(target_tokens)
+        return max(0, need - have)
+
+    def allocate(self, seq_id: str, target_tokens: int) -> bool:
+        """Grow `seq_id`'s table to cover `target_tokens` total tokens.
+        Atomic: returns False (and allocates nothing) on a shortfall.
+        Raises CacheOverflowError when the request exceeds the whole
+        cache — no amount of preemption can satisfy it."""
+        if target_tokens > self.capacity_tokens:
+            raise CacheOverflowError(
+                f"sequence needs {target_tokens} tokens; the cache holds "
+                f"{self.capacity_tokens} "
+                f"({self.num_blocks}x{self.block_size})")
+        with self._lock:
+            deficit = self._deficit(seq_id, target_tokens)
+            if deficit > len(self._free):
+                return False
+            table = self._tables.setdefault(seq_id, [])
+            for _ in range(deficit):
+                table.append(self._free.pop())
+            return True
+
+    def free(self, seq_id: str) -> int:
+        """Release every block of a retired/preempted sequence; returns
+        how many blocks came back."""
+        with self._lock:
+            table = self._tables.pop(seq_id, [])
+            self._lens.pop(seq_id, None)
+            self._free.extend(reversed(table))
+            return len(table)
+
+    # -- storage -------------------------------------------------------
+    def _slot(self, seq_id: str, pos: int) -> Tuple[int, int]:
+        table = self._tables.get(seq_id)
+        if table is None or pos // self.block_size >= len(table):
+            raise IndexError(
+                f"position {pos} of sequence {seq_id!r} has no allocated "
+                f"block (table covers "
+                f"{len(table or ()) * self.block_size} tokens)")
+        return table[pos // self.block_size], pos % self.block_size
+
+    def write(self, seq_id: str, pos: int, value) -> None:
+        """Store one token's KV entry at logical position `pos`."""
+        with self._lock:
+            block, off = self._slot(seq_id, pos)
+            if self._ns is np:
+                self._buffer[block, off] = value
+            else:
+                self._buffer = self._buffer.at[block, off].set(value)
+            self._lens[seq_id] = max(self._lens.get(seq_id, 0), pos + 1)
+
+    def write_range(self, seq_id: str, start: int, values) -> None:
+        """Store KV entries for positions [start, start+len(values)) —
+        the prefill bulk write, one block-sized slice at a time."""
+        n = len(values)
+        with self._lock:
+            pos = start
+            written = 0
+            while written < n:
+                block, off = self._slot(seq_id, pos)
+                take = min(self.block_size - off, n - written)
+                chunk = values[written:written + take]
+                if self._ns is np:
+                    self._buffer[block, off:off + take] = chunk
+                else:
+                    self._buffer = self._buffer.at[
+                        block, off:off + take].set(chunk)
+                written += take
+                pos += take
+            self._lens[seq_id] = max(self._lens.get(seq_id, 0), start + n)
+
+    def gather(self, seq_id: str, length: Optional[int] = None):
+        """Contiguous `[length, *kv_shape]` view of a sequence's cache —
+        what the model's decode step attends over. Copies only at block
+        granularity (numpy fancy-indexing over whole blocks)."""
+        with self._lock:
+            table = self._tables.get(seq_id, [])
+            n = self._lens.get(seq_id, 0) if length is None else length
+            if n == 0:
+                return self._buffer[0, 0:0]
+            nblocks = math.ceil(n / self.block_size)
+            idx = table[:nblocks]
+            if self._ns is np:
+                out = self._buffer[idx].reshape(
+                    (nblocks * self.block_size,) + self.kv_shape)
+            else:
+                out = self._ns.reshape(
+                    self._buffer[self._ns.asarray(idx)],
+                    (nblocks * self.block_size,) + self.kv_shape)
+            return out[:n]
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            used = self.num_blocks - len(self._free)
+            return {
+                "num_blocks": self.num_blocks,
+                "block_size": self.block_size,
+                "used_blocks": used,
+                "free_blocks": len(self._free),
+                "utilization": used / self.num_blocks,
+                "sequences": len(self._tables),
+            }

@@ -1,0 +1,315 @@
+"""Decode-step model shims for the continuous-batching engine.
+
+The iteration scheduler drives any model through two calls:
+
+- ``prefill(tokens) -> (next_token_logits [V], kv [S, *kv_token_shape])``
+  — run the prompt once, return the logits that predict the first
+  generated token plus the per-position KV entries to cache;
+- ``decode(kvs, last_tokens, positions) -> (logits [B, V],
+  new_kv [B, *kv_token_shape])`` — one incremental step for a batch of
+  sequences: ``kvs[i]`` is sequence i's cached KV gathered from the
+  block manager (``[positions[i], *kv_token_shape]``), ``last_tokens[i]``
+  the most recent token (not yet cached), ``positions[i]`` its position.
+
+Two implementations:
+
+- **TinyLM** — a deterministic pure-numpy model whose next token is a
+  fixed function of the *cached* KV contents, so every block-table bug
+  (wrong block, stale entry, bad gather order) changes the output. This
+  is what makes the scheduler fully testable under ``JAX_PLATFORMS=cpu``
+  in the seconds-fast unit tier.
+- **TransformerEngineModel** — incremental KV decoding over the
+  flagship ``models/transformer.py`` weights (same params pytree, same
+  rmsnorm/rotary/attention math as `plain_attention`), jit-compiled once
+  per (batch, seq) *bucket*: inputs are padded up to power-of-two
+  bucket sizes so the number of distinct compiled shapes stays
+  O(log max_batch * log max_seq) instead of one per request mix.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class TinyLM:
+    """Deterministic cache-exercising toy LM.
+
+    KV entry per token = ``float(token)`` (shape ``(1,)``). The next
+    token is ``2 + (sum(cached) + 7*last + 3*pos) % (vocab-2)`` — a pure
+    function of the full token history, but computed FROM THE CACHE, so
+    the engine only reproduces the oracle (`TinyLM.oracle`) if block
+    allocation, writes, gathers, preemption-requeue and re-prefill are
+    all correct. Token ids 0 (pad) and 1 (eos) are reserved; when
+    ``eos_period`` is set, the hash landing on a multiple emits eos.
+    """
+
+    kv_token_shape: Tuple[int, ...] = (1,)
+    kv_dtype = np.float32
+
+    def __init__(self, vocab_size: int = 32, eos_period: int = 0,
+                 step_delay_s: float = 0.0):
+        assert vocab_size >= 4
+        self.vocab_size = vocab_size
+        self.eos_token = 1
+        self.eos_period = eos_period
+        self.step_delay_s = step_delay_s
+        self.prefill_calls = 0
+        self.decode_calls = 0
+
+    def _next(self, cached_sum: float, last: int, pos: int) -> int:
+        h = int(round(cached_sum)) + 7 * int(last) + 3 * int(pos)
+        if self.eos_period and h % self.eos_period == 0:
+            return self.eos_token
+        return 2 + h % (self.vocab_size - 2)
+
+    def prefill(self, tokens: Sequence[int]):
+        self.prefill_calls += 1
+        toks = np.asarray(tokens, np.int64)
+        kv = toks.astype(np.float32)[:, None]          # [S, 1]
+        nxt = self._next(float(toks[:-1].sum()), int(toks[-1]),
+                         len(toks) - 1)
+        logits = np.full((self.vocab_size,), -1e30, np.float32)
+        logits[nxt] = 0.0
+        return logits, kv
+
+    def decode(self, kvs: List[np.ndarray], last_tokens: Sequence[int],
+               positions: Sequence[int]):
+        self.decode_calls += 1
+        if self.step_delay_s:
+            import time
+
+            time.sleep(self.step_delay_s)
+        b = len(last_tokens)
+        logits = np.full((b, self.vocab_size), -1e30, np.float32)
+        new_kv = np.zeros((b,) + self.kv_token_shape, np.float32)
+        for i in range(b):
+            nxt = self._next(float(np.asarray(kvs[i]).sum()),
+                             int(last_tokens[i]), int(positions[i]))
+            logits[i, nxt] = 0.0
+            new_kv[i, 0] = float(last_tokens[i])
+        return logits, new_kv
+
+    def oracle(self, prompt: Sequence[int], max_new_tokens: int
+               ) -> List[int]:
+        """Reference generation, no cache: what the engine MUST emit."""
+        toks = list(prompt)
+        out: List[int] = []
+        while len(out) < max_new_tokens:
+            nxt = self._next(float(sum(toks[:-1])), toks[-1],
+                             len(toks) - 1)
+            out.append(nxt)
+            if nxt == self.eos_token:
+                break
+            toks.append(nxt)
+        return out
+
+
+class TransformerEngineModel:
+    """Incremental KV decoding over `models/transformer.py` weights.
+
+    KV entry per token: ``[n_layers, 2, n_heads, head_dim]`` float32.
+    Prefill runs a full causal forward (same math as the training
+    model's CPU path — rmsnorm, fused qkv, rotary, `plain_attention`
+    scaling, silu-gated FFN, tied embeddings) while collecting K/V;
+    decode attends one query token against the gathered cache. Both are
+    jit-compiled per shape bucket: sequence lengths pad to the next
+    power of two (>= block multiple), batches pad with masked dummy
+    rows, so compiles are bounded by the bucket count, not the request
+    mix. MoE configs are rejected (dense engine path only).
+    """
+
+    def __init__(self, params, cfg, max_batch_size: int = 8):
+        import jax.numpy as jnp
+
+        if cfg.is_moe:
+            raise ValueError("TransformerEngineModel supports dense "
+                             "configs only (num_experts == 0)")
+        self._params = params
+        self._cfg = cfg
+        self.vocab_size = cfg.vocab_size
+        self.eos_token = 1
+        self.kv_token_shape = (cfg.n_layers, 2, cfg.n_heads, cfg.head_dim)
+        self.kv_dtype = np.float32
+        self._max_batch = max_batch_size
+        self._prefill_jit: Dict[int, object] = {}   # S_pad -> fn
+        self._decode_jit: Dict[Tuple[int, int], object] = {}
+        self.prefill_calls = 0
+        self.decode_calls = 0
+        self._jnp = jnp
+
+    # -- shared math ---------------------------------------------------
+    @staticmethod
+    def _rot1(x, cos, sin, positions):
+        """Rotary for one token per row: x [B, H, D], positions [B]."""
+        import jax.numpy as jnp
+
+        c = cos[positions][:, None, :]   # [B, 1, D/2]
+        s = sin[positions][:, None, :]
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c],
+                               axis=-1).astype(x.dtype)
+
+    def _build_prefill(self, s_pad: int):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.transformer import _rmsnorm
+        from ray_tpu.ops.rotary import apply_rotary, rotary_freqs
+
+        cfg = self._cfg
+        h, hd = cfg.n_heads, cfg.head_dim
+
+        def run(params, tokens, length):
+            # tokens [S_pad] int32 (zero-padded), length scalar int32.
+            act = jnp.float32
+            x = params["embed"][tokens].astype(act)[None]   # [1,S,D]
+            cos, sin = rotary_freqs(hd, cfg.max_seq_len, cfg.rope_theta)
+            pos = jnp.arange(s_pad)
+            valid = pos < length
+            causal = (pos[:, None] >= pos[None, :]) & valid[None, :]
+
+            def layer(x, lp):
+                y = _rmsnorm(x, lp["ln1"])
+                qkv = jnp.einsum("bsd,dkh->kbsh", y,
+                                 lp["wqkv"].astype(act))
+                q = qkv[0].reshape(1, s_pad, h, hd)
+                k = qkv[1].reshape(1, s_pad, h, hd)
+                v = qkv[2].reshape(1, s_pad, h, hd)
+                q = apply_rotary(q, cos, sin, pos)
+                k = apply_rotary(k, cos, sin, pos)
+                scale = hd ** -0.5
+                scores = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q, k,
+                    preferred_element_type=jnp.float32) * scale
+                scores = jnp.where(causal[None, None], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1).astype(act)
+                o = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+                x = x + (o.reshape(1, s_pad, h * hd)
+                         @ lp["wo"].astype(act))
+                y = _rmsnorm(x, lp["ln2"])
+                gu = jnp.einsum("bsd,dkf->kbsf", y,
+                                lp["w13"].astype(act))
+                x = x + (jax.nn.silu(gu[0]) * gu[1]) @ lp["w2"].astype(act)
+                kv = jnp.stack([k[0], v[0]], axis=1)  # [S, 2, H, hd]
+                return x, kv
+
+            x, kvs = jax.lax.scan(layer, x, params["layers"])
+            x = _rmsnorm(x, params["ln_f"])
+            last = x[0, length - 1]
+            logits = jnp.einsum("d,vd->v", last,
+                                params["embed"].astype(act))
+            # kvs [L, S, 2, H, hd] -> [S, L, 2, H, hd]
+            return logits, kvs.transpose(1, 0, 2, 3, 4)
+
+        return jax.jit(run)
+
+    def _build_decode(self, b_pad: int, s_pad: int):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.transformer import _rmsnorm
+        from ray_tpu.ops.rotary import rotary_freqs
+
+        cfg = self._cfg
+        h, hd = cfg.n_heads, cfg.head_dim
+        rot1 = self._rot1
+
+        def run(params, tokens, positions, cache):
+            # tokens [B], positions [B], cache [B, S_pad, L, 2, H, hd]
+            # (zero beyond each row's position).
+            act = jnp.float32
+            x = params["embed"][tokens].astype(act)       # [B, D]
+            cos, sin = rotary_freqs(hd, cfg.max_seq_len, cfg.rope_theta)
+            slot = jax.nn.one_hot(positions, s_pad, dtype=act)  # [B,S]
+            attend = (jnp.arange(s_pad)[None, :]
+                      <= positions[:, None])               # [B, S]
+            cache = cache.transpose(2, 0, 1, 3, 4, 5)  # [L,B,S,2,H,hd]
+
+            def layer(x, inputs):
+                lp, kv_l = inputs          # kv_l [B, S, 2, H, hd]
+                y = _rmsnorm(x, lp["ln1"])
+                qkv = jnp.einsum("bd,dkh->kbh", y,
+                                 lp["wqkv"].astype(act))
+                q = qkv[0].reshape(b_pad, h, hd)
+                k = qkv[1].reshape(b_pad, h, hd)
+                v = qkv[2].reshape(b_pad, h, hd)
+                q = rot1(q, cos, sin, positions)
+                k = rot1(k, cos, sin, positions)
+                # The new token's K/V lands in its own slot; the cache
+                # slot at `position` is zero by the manager's contract
+                # (blocks are allocated before being written).
+                keys = kv_l[:, :, 0] + slot[:, :, None, None] * k[:, None]
+                vals = kv_l[:, :, 1] + slot[:, :, None, None] * v[:, None]
+                scale = hd ** -0.5
+                scores = jnp.einsum(
+                    "bhd,bshd->bhs", q, keys,
+                    preferred_element_type=jnp.float32) * scale
+                scores = jnp.where(attend[:, None, :], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1).astype(act)
+                o = jnp.einsum("bhs,bshd->bhd", probs, vals)
+                x = x + o.reshape(b_pad, h * hd) @ lp["wo"].astype(act)
+                y = _rmsnorm(x, lp["ln2"])
+                gu = jnp.einsum("bd,dkf->kbf", y, lp["w13"].astype(act))
+                x = x + (jax.nn.silu(gu[0]) * gu[1]) @ lp["w2"].astype(act)
+                return x, jnp.stack([k, v], axis=1)   # [B, 2, H, hd]
+
+            x, new_kv = jax.lax.scan(layer, x, (params["layers"], cache))
+            x = _rmsnorm(x, params["ln_f"])
+            logits = jnp.einsum("bd,vd->bv", x,
+                                params["embed"].astype(act))
+            # new_kv [L, B, 2, H, hd] -> [B, L, 2, H, hd]
+            return logits, new_kv.transpose(1, 0, 2, 3, 4)
+
+        return jax.jit(run)
+
+    # -- engine interface ----------------------------------------------
+    def prefill(self, tokens: Sequence[int]):
+        jnp = self._jnp
+        self.prefill_calls += 1
+        n = len(tokens)
+        s_pad = _next_pow2(max(n, 8))
+        fn = self._prefill_jit.get(s_pad)
+        if fn is None:
+            fn = self._prefill_jit[s_pad] = self._build_prefill(s_pad)
+        padded = np.zeros((s_pad,), np.int32)
+        padded[:n] = np.asarray(tokens, np.int32)
+        logits, kv = fn(self._params, jnp.asarray(padded),
+                        jnp.int32(n))
+        return np.asarray(logits), np.asarray(kv[:n])
+
+    def decode(self, kvs: List[np.ndarray], last_tokens: Sequence[int],
+               positions: Sequence[int]):
+        jnp = self._jnp
+        self.decode_calls += 1
+        b = len(last_tokens)
+        # Bucket from the ACTUAL batch — never clamp below it (the
+        # engine's max_batch_size is an independent knob; clamping
+        # would drop rows). Bucket count stays O(log max-batch-seen).
+        b_pad = _next_pow2(max(b, 1))
+        s_pad = _next_pow2(max(max(int(p) for p in positions) + 1, 8))
+        key = (b_pad, s_pad)
+        fn = self._decode_jit.get(key)
+        if fn is None:
+            fn = self._decode_jit[key] = self._build_decode(*key)
+        cache = np.zeros((b_pad, s_pad) + self.kv_token_shape,
+                         np.float32)
+        toks = np.zeros((b_pad,), np.int32)
+        poss = np.zeros((b_pad,), np.int32)
+        for i in range(b):
+            n = int(positions[i])
+            if n:
+                cache[i, :n] = np.asarray(kvs[i])
+            toks[i] = int(last_tokens[i])
+            poss[i] = n
+        logits, new_kv = fn(self._params, jnp.asarray(toks),
+                            jnp.asarray(poss), jnp.asarray(cache))
+        return np.asarray(logits)[:b], np.asarray(new_kv)[:b]

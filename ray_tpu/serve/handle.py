@@ -95,14 +95,71 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Streaming counterpart of DeploymentResponse (reference:
+    serve.handle DeploymentResponseGenerator): wraps the replica's
+    ObjectRefGenerator; iterating yields VALUES as the replica produces
+    them — synchronously (`for item in gen`) or asynchronously
+    (`async for item in gen`). The router's in-flight count completes
+    when the stream exhausts, errors, or is closed."""
+
+    def __init__(self, handle: "DeploymentHandle", replica_id: str, gen):
+        self._handle = handle
+        self._replica_id = replica_id
+        self._gen = gen
+        self._done = False
+
+    def _complete(self) -> None:
+        if not self._done:
+            self._done = True
+            self._handle._router.complete(self._replica_id)
+
+    def completed(self) -> bool:
+        """True once the replica finished producing (the underlying
+        generator task is done)."""
+        return self._gen.completed()
+
+    def __iter__(self):
+        import ray_tpu
+
+        try:
+            for ref in self._gen:
+                yield ray_tpu.get(ref, timeout=60)
+        finally:
+            self._complete()
+
+    async def __aiter__(self):
+        import asyncio
+
+        import ray_tpu
+
+        end = object()   # StopIteration cannot cross a Future boundary
+        it = iter(self._gen)
+        try:
+            while True:
+                ref = await asyncio.to_thread(next, it, end)
+                if ref is end:
+                    return
+                yield await asyncio.to_thread(
+                    lambda r=ref: ray_tpu.get(r, timeout=60))
+        finally:
+            self._complete()
+
+    @property
+    def object_ref_generator(self):
+        return self._gen
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller_handle,
                  method_name: str = "__call__",
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "",
+                 stream: bool = False):
         self.deployment_name = deployment_name
         self._controller = controller_handle
         self._method_name = method_name
         self._multiplexed_model_id = multiplexed_model_id
+        self._stream = stream
         # Shared one-slot holder: every options() variant of this handle
         # uses the SAME Router (and its poller thread + model-affinity
         # cache) — a per-request options() call must never mint routers.
@@ -118,27 +175,36 @@ class DeploymentHandle:
         return self.__router_slot[0]
 
     def options(self, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None
-                ) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         """Per-request options (reference: handle.options): method_name
         routes to a named method; multiplexed_model_id tags the request
         for model-multiplexed replicas (serve/multiplex.py) and makes the
-        router prefer a replica with that model already warm."""
+        router prefer a replica with that model already warm;
+        stream=True makes `.remote()` return a
+        DeploymentResponseGenerator that yields items as the replica's
+        generator produces them (token streaming)."""
         dup = DeploymentHandle(
             self.deployment_name, self._controller,
             method_name=(self._method_name if method_name is None
                          else method_name),
             multiplexed_model_id=(
                 self._multiplexed_model_id
-                if multiplexed_model_id is None else multiplexed_model_id))
+                if multiplexed_model_id is None else multiplexed_model_id),
+            stream=self._stream if stream is None else stream)
         dup._DeploymentHandle__router_slot = self.__router_slot
         return dup
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         return self.remote_method(self._method_name, args, kwargs)
 
-    def remote_method(self, method_name: str, args, kwargs
-                      ) -> DeploymentResponse:
+    def remote_method(self, method_name: str, args, kwargs):
+        if self._stream:
+            replica_id, gen = self._router.assign(
+                method_name, args, kwargs,
+                model_id=self._multiplexed_model_id or None,
+                streaming=True)
+            return DeploymentResponseGenerator(self, replica_id, gen)
         replica_id, ref = self._router.assign(
             method_name, args, kwargs,
             model_id=self._multiplexed_model_id or None)
@@ -149,4 +215,5 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self._controller,
-                 self._method_name, self._multiplexed_model_id))
+                 self._method_name, self._multiplexed_model_id,
+                 self._stream))

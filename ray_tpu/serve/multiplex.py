@@ -6,6 +6,19 @@ LLM-adapter pattern: one replica holds up to N loaded models (LoRA
 adapters, per-tenant heads); requests carry a model id; the router
 prefers replicas that already have that model warm.
 
+Two guarantees the LRU makes under concurrency:
+
+- **single-flight loads**: concurrent `get_model` calls for the same
+  cold model id share ONE load (the `_loading` future) — an expensive
+  adapter is never loaded twice side by side;
+- **drain-deferred eviction**: evicting a model that an in-flight
+  request is still using defers the actual drop until that request
+  finishes. The replica opens a per-request "loan" scope
+  (`_begin_request_loans` / `_end_request_loans`); every model a
+  request touches is loaned to it, and eviction of a loaned model parks
+  it in `_pending_evict` (out of the LRU — new requests reload fresh)
+  until its loan count drains to zero.
+
 Usage:
 
     @serve.deployment
@@ -26,11 +39,18 @@ from __future__ import annotations
 
 import asyncio
 import contextvars
+import threading
 from collections import OrderedDict
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 _request_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
     "serve_multiplexed_model_id", default="")
+
+# Per-request loan scope: every (wrapper, model_id) the request touches.
+# Set by the replica around each request; plain code (incl. sync
+# generators on executor threads) sees its own copy per context.
+_request_loans: contextvars.ContextVar[Optional[List[Tuple[Any, str]]]] \
+    = contextvars.ContextVar("serve_multiplex_loans", default=None)
 
 
 def get_multiplexed_model_id() -> str:
@@ -43,6 +63,33 @@ def _set_request_model_id(model_id: str):
     return _request_model_id.set(model_id)
 
 
+def _begin_request_loans():
+    """Open a loan scope for the current request; returns an opaque
+    scope to pass to `_end_request_loans`. The loan list travels WITH
+    the scope (not just the contextvar) so overlapping scopes release
+    exactly their own loans."""
+    loans: List[Tuple[Any, str]] = []
+    return (_request_loans.set(loans), loans)
+
+
+def _end_request_loans(scope) -> None:
+    """Close the request's loan scope: release every model it borrowed
+    (deferred evictions drop here once the last borrower leaves)."""
+    token, loans = scope
+    try:
+        _request_loans.reset(token)
+    except ValueError:
+        # Generator bodies may resume under a different context than
+        # the one that created the token; the release below is what
+        # matters, the var itself resets with the context.
+        pass
+    for wrapper, model_id in loans:
+        try:
+            wrapper._release(model_id)
+        except Exception:
+            pass
+
+
 class _ModelMultiplexWrapper:
     """Per-replica LRU of loaded models keyed by model id."""
 
@@ -51,19 +98,62 @@ class _ModelMultiplexWrapper:
         self._owner = owner
         self._max = max(1, max_models)
         self._models: "OrderedDict[str, Any]" = OrderedDict()
-        self._loading: dict = {}       # model_id -> Future (dedup)
+        self._loading: dict = {}       # model_id -> Future (single-flight)
+        self._refs_lock = threading.Lock()
+        self._refs: Dict[str, int] = {}          # in-flight loans
+        self._pending_evict: Dict[str, Any] = {} # evicted, draining
 
     @property
     def model_ids(self):
         return list(self._models.keys())
 
+    # -- loan accounting (drain-deferred eviction) ---------------------
+    def _loan(self, model_id: str) -> None:
+        loans = _request_loans.get()
+        if loans is None:
+            return  # no request scope (direct call): immediate-evict mode
+        with self._refs_lock:
+            self._refs[model_id] = self._refs.get(model_id, 0) + 1
+        loans.append((self, model_id))
+
+    def _release(self, model_id: str) -> None:
+        """One borrower finished with the model; drop a parked eviction
+        once the last borrower leaves (this is where device memory
+        actually frees)."""
+        with self._refs_lock:
+            n = self._refs.get(model_id, 0) - 1
+            if n > 0:
+                self._refs[model_id] = n
+                return
+            self._refs.pop(model_id, None)
+            evicted = self._pending_evict.pop(model_id, None)
+        del evicted
+
+    def _evict_lru(self) -> None:
+        evicted_id, evicted = self._models.popitem(last=False)
+        with self._refs_lock:
+            if self._refs.get(evicted_id, 0) > 0:
+                # In use by an in-flight request: park it until the
+                # last borrower releases — dropping now would free the
+                # model under a request still running it.
+                self._pending_evict[evicted_id] = evicted
+                evicted = None
+        # Out of the LRU either way; give an unused model the chance to
+        # free device memory NOW (reference: calls __del__ on eviction).
+        del evicted
+
     async def load(self, model_id: str) -> Any:
         if model_id in self._models:
             self._models.move_to_end(model_id)      # LRU touch
+            self._loan(model_id)
             return self._models[model_id]
         pending = self._loading.get(model_id)
         if pending is not None:
-            return await asyncio.shield(pending)
+            model = await asyncio.shield(pending)
+            # The winner's load may have been evicted between its
+            # completion and our wake-up; loan whatever we hand out.
+            self._loan(model_id)
+            return model
         fut = asyncio.get_running_loop().create_future()
         self._loading[model_id] = fut
         try:
@@ -71,11 +161,9 @@ class _ModelMultiplexWrapper:
             if asyncio.iscoroutine(model):
                 model = await model
             while len(self._models) >= self._max:
-                evicted_id, evicted = self._models.popitem(last=False)
-                # Give the model a chance to free device memory NOW
-                # (reference: calls __del__ on eviction).
-                del evicted
+                self._evict_lru()
             self._models[model_id] = model
+            self._loan(model_id)
             fut.set_result(model)
             return model
         except BaseException as e:

@@ -1,0 +1,68 @@
+"""LLM-serving performance guards (`llm_serve` bench scenario).
+
+In-process (no cluster): the continuous-batching engine and the
+static-batching baseline run the identical `InferenceEngine` loop over
+the same deterministic TinyLM workload, so the vs-static ratio is a
+scheduling-policy measurement with most box noise common-moded out.
+
+Calibration (idle 2-CPU dev box, 2026-08, fresh): engine 2.4-2.7k
+tok/s vs static 0.9-1.0k on the mixed workload (ratio 2.66-2.87 — the
+structure guarantees it: static forms full-width batches but pays the
+long pole at shrinking occupancy, 186 model calls where continuous
+pays 55 for the same tokens), TTFT p50 25-33 ms, 2x-overload p99
+32-63 ms with thousands of pre-queue sheds. Floors/ceilings follow the
+repo's 75-80%-of-low-end rule, wide enough for harness contention:
+the ratio floor (1.5) only trips if iteration-level scheduling stops
+refilling slots; the p99 ceiling (1500 ms) only trips if overload work
+starts queuing unboundedly instead of shedding.
+
+Runs in the serialized perf tail stage (conftest reorders perf-marked
+tests last); fold-best over up to 3 rounds like the other guards.
+"""
+
+import pytest
+
+from ray_tpu.perf import run_llm_serve_bench
+
+pytestmark = [pytest.mark.perf]
+
+FLOORS = {
+    "llm_engine_tok_s": 800.0,
+    "llm_engine_vs_static": 1.5,
+    "llm_overload_shed": 1,       # 2x overload MUST shed, not queue
+    "llm_overload_served": 50,    # ...while still serving real traffic
+}
+CEILINGS = {
+    "llm_ttft_p50_ms": 300.0,
+    "llm_overload_p99_ms": 1500.0,
+}
+
+ROUNDS = 3
+
+
+def _violations(best):
+    out = []
+    for metric, floor in FLOORS.items():
+        if best[metric] < floor:
+            out.append(f"{metric}={best[metric]} < floor {floor}")
+    for metric, ceil in CEILINGS.items():
+        if best[metric] > ceil:
+            out.append(f"{metric}={best[metric]} > ceiling {ceil}")
+    return out
+
+
+def test_llm_serve_perf_guards():
+    best = {}
+    bad = ["never ran"]
+    for _ in range(ROUNDS):
+        r = run_llm_serve_bench(scale=0.5)
+        for m in FLOORS:
+            best[m] = max(best.get(m, float("-inf")), r[m])
+        for m in CEILINGS:
+            best[m] = min(best.get(m, float("inf")), r[m])
+        bad = _violations(best)
+        if not bad:
+            break
+    assert not bad, (
+        f"llm_serve guards violated: {bad}\n{best}\n"
+        "reproduce with: python -m ray_tpu.perf --llm-serve")
