@@ -99,6 +99,19 @@ def main() -> None:
     except Exception:
         pass
     try:
+        # Worker-direct dispatch rings (round 10): the remote tiny-task
+        # rate over driver->worker shm rings, with the zero-syscall
+        # honesty counters (enqueues vs doorbells, fallbacks) — the
+        # task-plane trajectory next to tasks_per_s/tasks_inline_per_s.
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.perf", "--ring",
+             "--scale", "0.5"],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        notes["ring"] = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        notes["ring_bench_error"] = repr(e)
+    try:
         # LLM-serving scenario (continuous-batching engine): sustained
         # tokens/s vs the static-batching baseline on the same mixed
         # workload, TTFT, and shed-mode p99 under 2x overload — the

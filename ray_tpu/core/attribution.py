@@ -41,8 +41,20 @@ the dispatch split (inline executions vs leased pushes);
 the caller-thread analogue of the worker split; ``lease.batch_size`` is
 a dimensionless distribution (``value()``: count = batched lease RPCs,
 mean/max = grants per RPC); ``ring.enq`` / ``ring.deq`` /
-``ring.doorbell`` / ``ring.fallback`` count submission-ring traffic
+``ring.doorbell`` / ``ring.fallback`` count ring-primitive traffic
 (fallback = specs the ring could not carry that took the RPC path).
+
+Round-10 worker-direct ring labels: ``ring.direct_enq`` counts task
+deltas the driver published straight onto a leased worker's ring (the
+zero-syscall dispatch tier; compare against ``ring.doorbell`` — under
+load doorbells must be ≪ enqueues), ``ring.worker_deq`` counts deltas
+the worker-side consumer decoded (its process's table), ``ring.reply``
+counts replies that came back over the twin ring and
+``ring.reply_fallback`` those that had to ride a server push instead
+(a full or broken reply ring shows up here, never hidden inside
+ring.reply); ``lease.return_batch`` is the return-side mirror of
+``lease.batch_size`` (count = batched return RPCs, mean/max = leases
+returned per RPC).
 
 Data-plane counters (round 7, the zero-copy audit — counts, not
 durations): ``get.nd_view`` array gets served as a zero-copy view over

@@ -26,6 +26,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
+import msgpack
 
 from ray_tpu.core import attribution, serialization
 from ray_tpu.core.config import ray_config
@@ -234,15 +235,29 @@ class ClusterRuntime:
         self._ring_enabled = cfg.submit_ring
         self._ring_slots = cfg.submit_ring_slots
         self._ring_slot_bytes = cfg.submit_ring_slot_bytes
+        self._lease_return_batching = cfg.lease_return_batching
         # Per-function exec-time EMA (seconds), fed by exec_us riding
         # every task reply and by inline runs; the inline gate admits
         # only functions whose EMA is KNOWN and below the threshold, so
         # a long or blocking task is never inlined on spec.
         self._fn_cost: Dict[str, float] = {}
-        # Submission-ring state: None = not set up, False = setup
-        # failed (RPC path permanently), dict = live.
-        self._ring: Any = None
-        self._ring_waiters: Dict[str, Any] = {}
+        # Worker-direct dispatch rings (round 10): worker_id -> ring
+        # state dict while live, False once that worker's pair failed
+        # or died (RPC push path for the rest of the lease). Driver
+        # side only; the worker side lives in conn.metadata of the
+        # attaching connection (handle_attach_task_ring).
+        self._worker_rings: Dict[str, Any] = {}
+        self._worker_ring_setups: Dict[str, Any] = {}
+        # Worker-mode: live task-ring states (for shutdown cleanup).
+        self._task_rings: List[dict] = []
+        # Batched lease returns (round 10): raylet address -> pending
+        # batch, flushed by one deferred pump per burst.
+        self._pending_lease_returns: Dict[str, dict] = {}
+        # Strong refs for fire-and-forget ring/return tasks: the event
+        # loop only keeps WEAK task references (the _BatchQueue
+        # rationale) — a collected flush task would strand its batch's
+        # awaiters and leak the leases at the raylet.
+        self._ring_bg_tasks: set = set()
         # Every granted task lease, until returned — the lease watchdog
         # sweeps this for orphans (see _lease_watchdog).
         self._live_leases: List[dict] = []
@@ -556,7 +571,7 @@ class ClusterRuntime:
             self._loop.run(self._server.stop(), timeout=2)
         except Exception:
             pass
-        self._close_submit_ring()
+        self._close_worker_rings()
         self._shm.close()
         self._exec_pool.shutdown(wait=False, cancel_futures=True)
         pool = getattr(self, "_cgraph_deposit_pool", None)
@@ -1757,18 +1772,24 @@ class ClusterRuntime:
         worker["push_started"] = push_t0
         worker["push_task_name"] = spec.get("name")
         try:
-            # Submission-ring push (round 8, core/ring.py): a template-
-            # encoded spec bound for a chip-less worker on OUR node can
-            # ride the shm ring — the raylet forwards the delta to the
-            # leased worker and the completion comes back the same way.
-            # Any miss (ring off/failed, no template, remote node, ring
-            # full, oversized delta) falls through to the RPC push.
+            # Worker-direct ring push (round 10, core/ring.py): a
+            # template-encoded, non-streaming spec bound for a
+            # ring-capable chip-less worker on OUR node rides a
+            # dedicated driver<->worker shm ring pair — no raylet, no
+            # socket on the per-task path; the reply (exec_us,
+            # attribution split) comes back on the twin ring. Any miss
+            # (ring off/failed, no template, remote node, streaming,
+            # ring full, oversized delta) falls through to the RPC
+            # push, byte-identically.
             ring_fut = None
             if (self._ring_enabled and tmpl is not None
+                    and worker.get("ring_capable")
+                    and not spec.get("streaming")
                     and worker.get("raylet_address")
                     == self.raylet_address
                     and not worker.get("chip_ids")):
-                ring_fut = await self._ring_enqueue(spec, tmpl, worker)
+                ring_fut = await self._worker_ring_enqueue(
+                    spec, tmpl, worker)
             if ring_fut is not None:
                 # Pipelining: the lease recirculates once the entry is
                 # published, exactly like a wire push (see below).
@@ -1833,62 +1854,90 @@ class ClusterRuntime:
         self._record_task_reply(spec, reply)
         self._offer_worker(key, worker)
 
-    # -- shared-memory submission ring (round 8; core/ring.py) ---------
-    async def _ensure_submit_ring(self) -> Optional[dict]:
-        """Lazily create the driver<->raylet ring pair (we own the
-        segments/FIFOs; the raylet attaches). Single-flight: every
-        concurrent submit awaits ONE cached setup task — without this,
-        a cold burst's coroutines would each interleave past the `is
-        None` check at the attach await and create orphan ring pairs.
-        A failed setup latches False — the RPC push path is the
-        permanent fallback, never retried per task."""
-        if self._ring is not None:
-            return self._ring or None
-        setup = getattr(self, "_ring_setup", None)
+    # -- worker-direct dispatch rings: driver side (round 10) ----------
+    async def _ensure_worker_ring(self, worker: dict) -> Optional[dict]:
+        """Ring pair for one leased worker, established lazily on the
+        lease's first ring-eligible push (we own the segments/FIFOs;
+        the worker attaches). Single-flight per worker: a cold burst's
+        coroutines all await ONE setup instead of racing orphan pairs.
+        A failed or dead pair latches False — the RPC push path serves
+        the rest of the lease, never retried per task."""
+        wid = worker["worker_id"]
+        st = self._worker_rings.get(wid)
+        if st is not None:
+            return st if isinstance(st, dict) and st.get("live") else None
+        setup = self._worker_ring_setups.get(wid)
         if setup is None:
-            setup = self._ring_setup = asyncio.ensure_future(
-                self._setup_submit_ring())
+            setup = self._worker_ring_setups[wid] = asyncio.ensure_future(
+                self._setup_worker_ring(worker))
+            # The SETUP task owns its registry entry: a cancelled
+            # awaiter (push coroutines can be cancelled mid-await)
+            # must not pop a still-running setup — that would let a
+            # second setup race the first and orphan a pair whose
+            # waiters nobody ever completes.
+            setup.add_done_callback(
+                lambda _f: self._worker_ring_setups.pop(wid, None))
         await setup
-        return self._ring or None
+        st = self._worker_rings.get(wid)
+        return st if isinstance(st, dict) and st.get("live") else None
 
-    async def _setup_submit_ring(self) -> None:
-        files = []
+    async def _setup_worker_ring(self, worker: dict) -> None:
+        from ray_tpu.core import ring as ringmod
+
+        wid = worker["worker_id"]
+        files: List[Tuple[str, str]] = []
         writer = reader = None
         registered_fd = None
         loop = asyncio.get_running_loop()
         try:
-            from ray_tpu.core import ring as ringmod
-
             sub_name, sub_fifo = ringmod.create_ring(
-                "rtsub", self._ring_slots, self._ring_slot_bytes)
+                "rtwsub", self._ring_slots, self._ring_slot_bytes)
             files.append((sub_name, sub_fifo))
             comp_name, comp_fifo = ringmod.create_ring(
-                "rtcmp", self._ring_slots, self._ring_slot_bytes)
+                "rtwcmp", self._ring_slots, self._ring_slot_bytes)
             files.append((comp_name, comp_fifo))
             writer = ringmod.RingWriter(sub_name, sub_fifo)
             reader = ringmod.RingReader(comp_name, comp_fifo)
-            # Completion fallback (full/oversized completion ring) rides
-            # a server push on the raylet connection; register before
-            # attach so no completion can beat the handler.
-            self._raylet.on_push("ring_completion",
-                                 self._ring_complete_msg)
+            client = await self._worker_client(worker["worker_address"])
+            st = {
+                "worker_id": wid,
+                "writer": writer, "reader": reader, "files": files,
+                "templates": {}, "next_tmpl": 0,
+                "waiters": {}, "client": client, "live": True,
+            }
+            # Reply fallback (full reply ring / oversized reply) rides
+            # a server push on the worker connection; register before
+            # attach so no reply can beat the handler. The handler
+            # resolves the CURRENT ring through the registry instead
+            # of capturing `st`: the cached client outlives any one
+            # ring, and a captured state would pin a torn-down pair
+            # (reader/writer + up to 512 template dicts) for as long
+            # as the client lives.
+            client.on_push(
+                "ring_completion",
+                lambda msg, wid=wid: self._worker_ring_push_reply(
+                    wid, msg))
             loop.add_reader(reader.doorbell_fd,
-                            self._drain_ring_completions)
+                            self._drain_worker_ring, st)
             registered_fd = reader.doorbell_fd
-            await self._raylet.call(
-                "attach_submit_ring", sub_name=sub_name,
+            await client.call(
+                "attach_task_ring", sub_name=sub_name,
                 sub_fifo=sub_fifo, comp_name=comp_name,
                 comp_fifo=comp_fifo, timeout=10.0)
-            self._ring = {
-                "writer": writer, "reader": reader,
-                "files": files,
-                "templates": {}, "next_tmpl": 0,
-                "backstop": asyncio.ensure_future(
-                    self._ring_backstop_loop()),
-            }
+            st["backstop"] = asyncio.ensure_future(
+                self._worker_ring_backstop(st))
+            self._worker_rings[wid] = st
+            # The raylet pins ring-attached workers against idle
+            # recycling until detach: a returned worker must never
+            # carry a stale ring into another lease.
+            try:
+                await self._raylet.notify("worker_ring_attached",
+                                          worker_id=wid)
+            except Exception:
+                pass
         except Exception:
-            logger.warning("submission ring setup failed; staying on "
-                           "the RPC push path", exc_info=True)
+            logger.warning("worker ring setup for %s failed; staying on "
+                           "the RPC push path", wid[:8], exc_info=True)
             # Tear down everything this attempt created: the segments
             # were deliberately untracked from the resource_tracker, so
             # nothing else will ever unlink them.
@@ -1903,94 +1952,120 @@ class ClusterRuntime:
                         end.close()
                     except Exception:
                         pass
-            from ray_tpu.core.ring import destroy_ring
-
             for name, fifo in files:
-                destroy_ring(name, fifo)
-            self._ring = False
+                ringmod.destroy_ring(name, fifo)
+            self._worker_rings[wid] = False
 
-    async def _ring_enqueue(self, spec: dict, tmpl: SpecTemplate,
-                            worker: dict) -> Optional[Any]:
-        """Publish one template-spec delta; returns the completion
-        future, or None when the entry cannot ride the ring (caller
-        falls back to the RPC push)."""
-        import msgpack
-
-        ring = await self._ensure_submit_ring()
-        if ring is None:
+    async def _worker_ring_enqueue(self, spec: dict, tmpl: SpecTemplate,
+                                   worker: dict) -> Optional[Any]:
+        """Publish one template-spec delta on the leased worker's own
+        ring; returns the reply future, or None when the entry cannot
+        ride the ring (caller falls back to the RPC push)."""
+        st = await self._ensure_worker_ring(worker)
+        if st is None:
             return None
-        # One-time template registration per (fn, options, env) shape.
-        # Entries hold (id, registered-future, STRONG template ref):
-        # the future gates concurrent first-users (a delta must never
-        # hit the ring before its template landed at the raylet), and
-        # the strong ref pins the object so a recycled id() can never
-        # alias a stale entry onto the wrong template.
-        entry = ring["templates"].get(id(tmpl))
+        # One-time template registration per (fn, options, env) shape
+        # PER RING. Entries hold (id, registered-future, STRONG
+        # template ref): the future gates concurrent first-users (a
+        # delta must never hit the ring before its template landed at
+        # the worker), the strong ref pins the object so a recycled
+        # id() can never alias a stale entry onto the wrong template.
+        entry = st["templates"].get(id(tmpl))
         if entry is None:
-            if len(ring["templates"]) >= 512:
-                ring["templates"].clear()   # bounded; re-registers
-            tmpl_id = ring["next_tmpl"]
-            ring["next_tmpl"] += 1
+            if len(st["templates"]) >= 512:
+                st["templates"].clear()   # bounded; re-registers
+            tmpl_id = st["next_tmpl"]
+            st["next_tmpl"] += 1
             reg = asyncio.get_running_loop().create_future()
-            ring["templates"][id(tmpl)] = (tmpl_id, reg, tmpl)
+            st["templates"][id(tmpl)] = (tmpl_id, reg, tmpl)
             try:
-                await self._raylet.call("register_spec_template",
+                await st["client"].call("register_task_template",
                                         template_id=tmpl_id,
                                         base=tmpl._base, timeout=10.0)
                 reg.set_result(True)
             except Exception:
-                ring["templates"].pop(id(tmpl), None)
+                st["templates"].pop(id(tmpl), None)
                 reg.set_result(False)
                 return None
         else:
             tmpl_id, reg = entry[0], entry[1]
             if not await reg:
                 return None
-        delta = {"t": tmpl_id, "w": worker["worker_id"],
-                 "task_id": spec["task_id"], "args": spec["args"],
+        if not st.get("live"):
+            return None   # died while we awaited the registration
+        delta = {"t": tmpl_id, "task_id": spec["task_id"],
+                 "args": spec["args"],
                  "arg_oids": spec.get("arg_oids") or [],
                  "trace_ctx": spec.get("trace_ctx")}
         payload = msgpack.packb(delta, use_bin_type=True)
         fut = asyncio.get_running_loop().create_future()
-        self._ring_waiters[spec["task_id"]] = fut
-        if not ring["writer"].push(payload):
+        st["waiters"][spec["task_id"]] = fut
+        if not st["writer"].push(payload):
             # Full ring or oversized delta: not an error, just a miss.
-            self._ring_waiters.pop(spec["task_id"], None)
+            st["waiters"].pop(spec["task_id"], None)
             if attribution.enabled:
                 attribution.count("ring.fallback")
             return None
+        if attribution.enabled:
+            attribution.count("ring.direct_enq")
         return fut
 
-    def _drain_ring_completions(self) -> None:
-        import msgpack
-
-        ring = self._ring
-        if not ring:
-            return
+    def _drain_worker_ring(self, st: dict) -> int:
+        if not st.get("live"):
+            return 0
         try:
-            drained = ring["reader"].drain()
+            drained = st["reader"].drain()
         except (OSError, ValueError):
-            return  # ring torn down under the callback
+            return 0  # ring torn down under the callback
+        if drained:
+            # Doorbell-served drains must feed the backstop's pacing
+            # too ("activity", read-and-reset each backstop tick):
+            # otherwise active traffic served entirely by doorbells
+            # looks idle to the poll and it backs off to the idle
+            # period exactly when the lost-wakeup race matters.
+            st["activity"] = st.get("activity", 0) + len(drained)
+            if attribution.enabled:
+                # Counted HERE so ring.reply means exactly "replies
+                # that rode the twin ring" — fallback server pushes
+                # count under ring.reply_fallback instead (a full/
+                # broken reply ring must be visible in the counters).
+                attribution.count("ring.reply", len(drained))
         for raw in drained:
-            self._ring_complete_msg(msgpack.unpackb(raw, raw=False))
+            self._worker_ring_complete(st,
+                                       msgpack.unpackb(raw, raw=False))
+        return len(drained)
 
-    def _ring_complete_msg(self, msg: Any) -> None:
+    def _spawn_ring_task(self, coro) -> None:
+        """ensure_future with a strong reference held until done (must
+        run on the loop thread)."""
+        t = asyncio.ensure_future(coro)
+        self._ring_bg_tasks.add(t)
+        t.add_done_callback(self._ring_bg_tasks.discard)
+
+    def _worker_ring_push_reply(self, wid: str, msg: Any) -> None:
+        """Server-push reply fallback, routed to whatever ring is
+        CURRENTLY live for this worker (no reply can arrive before the
+        ring registers: deltas only flow after setup publishes it)."""
+        st = self._worker_rings.get(wid)
+        if isinstance(st, dict):
+            if attribution.enabled:
+                attribution.count("ring.reply_fallback")
+            self._worker_ring_complete(st, msg)
+
+    def _worker_ring_complete(self, st: dict, msg: Any) -> None:
         if not isinstance(msg, dict):
             return
-        fut = self._ring_waiters.pop(msg.get("task_id"), None)
+        fut = st["waiters"].pop(msg.get("task_id"), None)
         if fut is None or fut.done():
             return
         err = msg.get("error")
         if err is not None:
             if "unknown spec template" in err:
-                # The raylet no longer knows an id we cached (should be
-                # unreachable given its oldest-first eviction bound,
-                # but a raylet restart clears everything): drop OUR
-                # cache so the retry re-registers instead of re-sending
-                # the dead id forever.
-                ring = self._ring
-                if isinstance(ring, dict):
-                    ring["templates"].clear()
+                # The worker no longer knows an id we cached (should
+                # be unreachable given its oldest-first eviction
+                # bound): drop OUR cache so the retry re-registers
+                # instead of re-sending the dead id forever.
+                st["templates"].clear()
             # Same shape a failed wire push produces: the submit retry
             # loop treats it as a worker/transport fault.
             fut.set_exception(ConnectionLost(
@@ -1998,49 +2073,127 @@ class ClusterRuntime:
         else:
             fut.set_result(msg.get("reply"))
 
-    async def _ring_backstop_loop(self) -> None:
-        """Coarse re-check of the completion ring (lost-wakeup backstop,
-        ring.py docstring) + raylet-death failfast for ring waiters —
-        a dead raylet can never complete them."""
-        from ray_tpu.core.ring import BACKSTOP_POLL_S
+    async def _worker_ring_backstop(self, st: dict) -> None:
+        """Adaptive lost-wakeup backstop (ring.AdaptivePoll: base
+        period under traffic, decaying toward the idle period) +
+        worker-death failfast — a dead worker can never complete its
+        ring entries, so waiters must fail onto the ConnectionLost
+        retry path instead of hanging their get() forever."""
+        from ray_tpu.core.ring import AdaptivePoll
 
-        while True:
-            await asyncio.sleep(BACKSTOP_POLL_S)
-            self._drain_ring_completions()
-            if not self._raylet.connected and self._ring_waiters:
-                waiters, self._ring_waiters = self._ring_waiters, {}
-                for fut in waiters.values():
-                    if not fut.done():
-                        fut.set_exception(
-                            ConnectionLost("raylet connection lost with "
-                                           "ring submissions in flight"))
+        poll = AdaptivePoll()
+        while st.get("live"):
+            await asyncio.sleep(poll.interval)
+            self._drain_worker_ring(st)
+            # "activity" accumulates doorbell-served drains between
+            # ticks (plus this tick's own), so traffic keeps the poll
+            # at its base period regardless of which path drained it.
+            poll.observe(st.pop("activity", 0))
+            if not st["client"].connected:
+                self._fail_worker_ring(
+                    st, "worker connection lost with ring submissions "
+                        "in flight")
+                return
 
-    def _close_submit_ring(self) -> None:
-        ring = self._ring
-        self._ring = False
-        if not isinstance(ring, dict):
+    def _fail_worker_ring(self, st: dict, why: str) -> None:
+        """The worker died (or its ring broke) with entries possibly
+        in flight: fail every waiter with ConnectionLost — the submit
+        retry loop treats that exactly like a failed RPC push (lease
+        marked dead, task re-leased elsewhere) — and retire the pair,
+        pinning this worker_id to the RPC path."""
+        waiters, st["waiters"] = st["waiters"], {}
+        for fut in waiters.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(why))
+        self._teardown_worker_ring(st, latch_failed=True)
+
+    async def _detach_worker_ring(self, st: dict) -> None:
+        """Lease return detaches and destroys the pair: tell the
+        worker to drop its end (best effort — it may already be dead),
+        un-pin at the raylet, then close + unlink our segments. Runs
+        BEFORE the lease-return RPC so a recycled worker can never
+        carry a stale ring into its next lease."""
+        wid = st["worker_id"]
+        if st.get("live"):
+            try:
+                await st["client"].call("detach_task_ring", timeout=5.0)
+            except Exception:
+                pass
+        # Any reply that raced the detach is drained now; a waiter
+        # still pending after that can only mean lost work — fail it
+        # onto the retry path rather than hang its get() forever.
+        self._drain_worker_ring(st)
+        waiters, st["waiters"] = st["waiters"], {}
+        for fut in waiters.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(
+                    "lease returned with ring submissions in flight"))
+        try:
+            await self._raylet.notify("worker_ring_detached",
+                                      worker_id=wid)
+        except Exception:
+            pass
+        self._teardown_worker_ring(st, latch_failed=False)
+
+    def _teardown_worker_ring(self, st: dict, latch_failed: bool) -> None:
+        """Close + destroy one driver-side pair (we own the files).
+        latch_failed=True pins the worker_id to the RPC path (dead
+        worker); False forgets it, so re-leasing the same live worker
+        attaches a fresh pair."""
+        if not st.get("live"):
             return
-        from ray_tpu.core.ring import destroy_ring
-
-        backstop = ring.get("backstop")
+        st["live"] = False
+        backstop = st.get("backstop")
         if backstop is not None:
             try:
-                self._loop.call_soon(backstop.cancel)
+                backstop.cancel()
             except Exception:
                 pass
         try:
-            fd = ring["reader"].doorbell_fd
-            self._loop.call_soon(
-                lambda: self._loop.loop.remove_reader(fd))
+            self._loop.loop.remove_reader(st["reader"].doorbell_fd)
         except Exception:
             pass
-        for end in (ring["writer"], ring["reader"]):
+        for end in (st["writer"], st["reader"]):
             try:
                 end.close()
             except Exception:
                 pass
-        for name, fifo in ring["files"]:
+        from ray_tpu.core.ring import destroy_ring
+
+        for name, fifo in st["files"]:
             destroy_ring(name, fifo)
+        if latch_failed:
+            self._worker_rings[st["worker_id"]] = False
+        else:
+            self._worker_rings.pop(st["worker_id"], None)
+
+    def _close_worker_rings(self) -> None:
+        """Shutdown sweep: every still-live driver-side pair (waiters
+        failed loudly — a silently dropped submission would hang some
+        get() forever), plus, in worker mode, any task ring attached
+        to this process. Runs the teardown on the RPC loop when it is
+        still alive (reader-fd deregistration and backstop cancels are
+        loop-owned state); falls back to direct cleanup otherwise."""
+
+        def _sweep() -> None:
+            for st in [s for s in self._worker_rings.values()
+                       if isinstance(s, dict)]:
+                self._fail_worker_ring(st, "runtime shut down with ring "
+                                           "submissions in flight")
+            self._worker_rings.clear()
+            for st in list(self._task_rings):
+                self._detach_task_ring_state(st)
+
+        if not (self._worker_rings or self._task_rings):
+            return
+
+        async def _on_loop():
+            _sweep()
+
+        try:
+            self._loop.run(_on_loop(), timeout=5)
+        except Exception:
+            _sweep()
 
     def _record_task_reply(self, spec: dict, reply: dict) -> None:
         task_id = spec["task_id"]
@@ -2240,7 +2393,11 @@ class ClusterRuntime:
         lingered = 0.0
         while worker in pool.idle and worker.get("pipeline", 0) > 0:
             # Pipelined pushes still executing: the lease cannot be
-            # returned yet. Bounded wait — a pipeline counter that never
+            # returned yet. Ring-published entries hold the same
+            # pipeline counter, so a ring-attached lease with in-flight
+            # slots is pinned against return (and hence against raylet
+            # recycling) exactly like an in-flight RPC push. Bounded
+            # wait — a pipeline counter that never
             # drains (accounting bug, wedged push) must not pin the
             # raylet's resources forever; force-return past the cap.
             if lingered > 10.0:
@@ -2401,30 +2558,83 @@ class ClusterRuntime:
                 await self._return_worker(worker)
 
     async def _return_worker(self, worker: dict, dead: bool = False) -> None:
+        # A ring-attached lease detaches and destroys its pair BEFORE
+        # the return reaches the raylet (see _detach_worker_ring).
+        st = self._worker_rings.get(worker.get("worker_id"))
+        if isinstance(st, dict):
+            await self._detach_worker_ring(st)
+        elif st is False:
+            # The failed/dead latch covers only THIS lease: forget it
+            # at return so a future lease of the same (live) worker
+            # can attach a fresh pair — and retired workers' latches
+            # don't accumulate in the map forever.
+            self._worker_rings.pop(worker.get("worker_id"), None)
+        item = {"lease_id": worker["lease_id"],
+                "worker_id": worker["worker_id"],
+                "resources": worker.get("resources", {}),
+                "dead": dead}
+        address = worker["raylet_address"]
+        if not self._lease_return_batching:
+            await self._send_lease_returns(address, [item])
+            return
+        # Batched lease returns (round 10, ROADMAP 4c): a burst's
+        # returns land as N items in THIS loop pass and the one
+        # deferred flush sends them as a single return_worker_leases
+        # RPC — the mirror of the round-8 grant batch, same
+        # deferred-pump discipline as _drain_submits/_schedule_pump.
+        batch = self._pending_lease_returns.get(address)
+        if batch is None:
+            batch = self._pending_lease_returns[address] = {
+                "items": [],
+                "fut": asyncio.get_running_loop().create_future()}
+            asyncio.get_running_loop().call_soon(
+                lambda: self._spawn_ring_task(
+                    self._flush_lease_returns(address)))
+        batch["items"].append(item)
+        await batch["fut"]
+
+    async def _flush_lease_returns(self, address: str) -> None:
+        batch = self._pending_lease_returns.pop(address, None)
+        if batch is None:
+            return
+        if attribution.enabled and len(batch["items"]) > 1:
+            attribution.value("lease.return_batch", len(batch["items"]))
+        try:
+            await self._send_lease_returns(address, batch["items"])
+        finally:
+            if not batch["fut"].done():
+                batch["fut"].set_result(None)
+
+    async def _send_lease_returns(self, address: str,
+                                  items: List[dict]) -> None:
         # A lost return leaks the lease's resources at the raylet FOREVER
         # (observed: returns timing out against a raylet busy with bulk
         # object IO starved a whole module's scheduling). Retry with
-        # backoff — handle_return_worker is idempotent — and log loudly
-        # if the lease could not be returned.
+        # backoff — both return handlers are idempotent — and log loudly
+        # if the lease(s) could not be returned.
         last: Optional[Exception] = None
         for attempt in range(4):
             if attempt:
                 await asyncio.sleep(0.5 * attempt)
             try:
-                client = await self._raylet_client(
-                    worker["raylet_address"])
-                await client.call("return_worker",
-                                  lease_id=worker["lease_id"],
-                                  worker_id=worker["worker_id"],
-                                  resources=worker.get("resources", {}),
-                                  dead=dead, timeout=10.0)
+                client = await self._raylet_client(address)
+                if len(items) == 1:
+                    it = items[0]
+                    await client.call("return_worker",
+                                      lease_id=it["lease_id"],
+                                      worker_id=it["worker_id"],
+                                      resources=it["resources"],
+                                      dead=it["dead"], timeout=10.0)
+                else:
+                    await client.call("return_worker_leases",
+                                      returns=items, timeout=10.0)
                 return
             except Exception as e:  # noqa: BLE001
                 last = e
-        logger.warning("could not return lease %s to %s after retries "
-                       "(%s); its resources may be stranded",
-                       worker.get("lease_id"),
-                       worker.get("raylet_address"), last)
+        logger.warning("could not return lease(s) %s to %s after retries "
+                       "(%s); their resources may be stranded",
+                       [it.get("lease_id") for it in items],
+                       address, last)
 
     # -- clients -------------------------------------------------------
     async def _raylet_client(self, address: str,
@@ -3593,6 +3803,248 @@ class ClusterRuntime:
             attr.update(reply.pop("attr_exec", None) or {})
             reply["attr"] = attr
         return reply
+
+    # -- worker-direct dispatch ring: worker side (round 10) -----------
+    async def handle_attach_task_ring(self, conn: ServerConnection, *,
+                                      sub_name: str, sub_fifo: str,
+                                      comp_name: str, comp_fifo: str
+                                      ) -> bool:
+        """The driver that leased this worker created a ring pair (it
+        owns the segments and FIFOs): attach the submit side as
+        consumer, the reply side as producer, and wake on the submit
+        doorbell. Deltas dequeued here execute through the SAME
+        `_execute_task` an RPC push runs — task_events, typed errors,
+        cancellation, exec_us, the attribution split, all identical —
+        and the reply rides the twin ring (a full reply ring or an
+        oversized reply falls back to a server push on this
+        connection, so a reply is never dropped)."""
+        from ray_tpu.core.ring import RingReader, RingWriter
+
+        self._detach_task_ring(conn)
+        reader = writer = None
+        state = None
+        try:
+            reader = RingReader(sub_name, sub_fifo)
+            writer = RingWriter(comp_name, comp_fifo)
+            state = {
+                "reader": reader,
+                "writer": writer,
+                "templates": {},
+                "conn": conn,
+                "live": True,
+            }
+            conn.metadata["task_ring"] = state
+            self._task_rings.append(state)
+            loop = asyncio.get_running_loop()
+            loop.add_reader(state["reader"].doorbell_fd,
+                            self._on_task_ring_doorbell, state)
+            state["poller"] = asyncio.ensure_future(
+                self._task_ring_backstop(state))
+        except BaseException:
+            # Partial attach must not leak our end's fds/mappings in a
+            # long-lived worker (the driver latches False and unlinks
+            # the files when this RPC errors).
+            if state is not None:
+                self._detach_task_ring(conn)
+            else:
+                for end in (reader, writer):
+                    if end is not None:
+                        try:
+                            end.close()
+                        except Exception:
+                            pass
+            raise
+        return True
+
+    async def handle_detach_task_ring(self, conn: ServerConnection
+                                      ) -> bool:
+        """Lease return: drop our end of the pair (the driver unlinks
+        the files once we have answered)."""
+        self._detach_task_ring(conn)
+        return True
+
+    async def handle_register_task_template(self, conn: ServerConnection,
+                                            *, template_id: int,
+                                            base: dict) -> bool:
+        """Invariant wire dict of a spec template, registered once per
+        (fn, options, env) shape per ring; deltas reference it by id so
+        the steady-state ring entry carries only per-call fields."""
+        state = conn.metadata.get("task_ring")
+        if state is None:
+            raise RpcError("no task ring attached on this connection")
+        while len(state["templates"]) >= 1024:
+            # Evict OLDEST-first (insertion order), never wholesale:
+            # the driver's own map clears at 512 and re-registers under
+            # fresh monotonic ids, so any id it still holds is among
+            # the newest <=512 registrations — old-end eviction can
+            # never invalidate a live id.
+            state["templates"].pop(next(iter(state["templates"])))
+        state["templates"][int(template_id)] = base
+        return True
+
+    def _on_task_ring_doorbell(self, state: dict) -> int:
+        try:
+            drained = state["reader"].drain()
+        except (OSError, ValueError):
+            return 0  # ring torn down under the callback
+        if drained:
+            # Feed the backstop's pacing (see _drain_worker_ring).
+            state["activity"] = state.get("activity", 0) + len(drained)
+        for raw in drained:
+            try:
+                self._submit_ring_task(state, raw)
+            except Exception:
+                # One malformed entry must not drop the REST of the
+                # drained batch on the floor (their waiters would hang
+                # with the worker still connected).
+                logger.warning("malformed ring entry dropped",
+                               exc_info=True)
+        return len(drained)
+
+    async def _task_ring_backstop(self, state: dict) -> None:
+        """Lost-wakeup backstop, adaptively paced (ring.AdaptivePoll):
+        base period while tasks flow, decaying to the idle period on a
+        quiet ring."""
+        from ray_tpu.core.ring import AdaptivePoll
+
+        poll = AdaptivePoll()
+        while state.get("live") and not state["reader"].closed:
+            await asyncio.sleep(poll.interval)
+            try:
+                self._on_task_ring_doorbell(state)
+                # Doorbell-served drains between ticks count as
+                # traffic too (same accounting as the driver side).
+                poll.observe(state.pop("activity", 0))
+            except Exception:
+                return  # ring torn down under us
+
+    def _submit_ring_task(self, state: dict, raw: bytes) -> None:
+        """Decode one delta on the loop thread (dict merge + fast
+        decode), then hand execution AND the reply to the single exec
+        thread: the reply rides the twin ring straight from that
+        thread (it is the reply ring's only producer, so SPSC holds).
+        A steady-state ring task therefore costs this worker zero
+        event-loop round trips — the run_in_executor reply hop of the
+        RPC push path (one call_soon_threadsafe self-pipe write per
+        task) never happens."""
+        attr_on = attribution.enabled
+        _t0 = time.perf_counter() if attr_on else 0.0
+        task_id = None
+        try:
+            delta = msgpack.unpackb(raw, raw=False)
+            task_id = delta.get("task_id")
+            base = state["templates"].get(delta.pop("t", None))
+            if base is None:
+                raise RpcError("unknown spec template")
+            merged = dict(base)
+            merged.update(delta)
+            # Ring deltas skip the per-connection handshake gate: the
+            # template base arrived over a validated registration and
+            # the delta fields are producer-controlled; any envelope
+            # shortfall still falls back to the validated decode
+            # inside from_wire_fast.
+            spec = from_wire_fast(merged, "TaskSpec")
+            if attr_on:
+                attribution.count("ring.worker_deq")
+        except Exception as e:  # noqa: BLE001
+            # A typed ring-level failure (user exceptions ride inside
+            # reply["results"]): the driver maps it onto the same
+            # ConnectionLost/retry path a failed RPC push takes. The
+            # reply still goes through the exec pool so the reply
+            # ring keeps its single producer. An entry so corrupt its
+            # task_id is unreadable cannot be error-replied — drop it
+            # loudly (the caller's per-entry guard keeps the rest of
+            # the batch flowing).
+            if task_id is None:
+                logger.warning("undecodable ring entry dropped: %s", e)
+                return
+            err = f"{type(e).__name__}: {e}"
+            self._submit_to_exec_pool(
+                self._task_ring_complete, state,
+                {"task_id": task_id, "error": err})
+            return
+        decode_us = int((time.perf_counter() - _t0) * 1e6) if attr_on \
+            else 0
+
+        def run_and_reply():
+            try:
+                # Refuse work the moment our raylet is gone, exactly
+                # like handle_push_task: exiting converts the stale
+                # lease into a clean worker-death retry at the owner.
+                self._die_if_orphaned()
+                reply = self._execute_task(spec)
+                if attr_on:
+                    attr = {"decode": decode_us}
+                    attr.update(reply.pop("attr_exec", None) or {})
+                    reply["attr"] = attr
+                else:
+                    reply.pop("attr_exec", None)
+                msg = {"task_id": task_id, "reply": reply}
+            except BaseException as e:  # noqa: BLE001
+                msg = {"task_id": task_id,
+                       "error": f"{type(e).__name__}: {e}"}
+            self._task_ring_complete(state, msg)
+
+        self._submit_to_exec_pool(run_and_reply)
+
+    def _submit_to_exec_pool(self, fn, *args) -> None:
+        try:
+            self._exec_pool.submit(fn, *args)
+        except RuntimeError:
+            pass  # pool shut down: the driver's failfast covers us
+
+    def _task_ring_complete(self, state: dict, msg: dict) -> None:
+        """Reply producer — runs on the exec thread (see
+        _submit_ring_task)."""
+        if not state.get("live"):
+            return
+        try:
+            payload = msgpack.packb(msg, use_bin_type=True)
+            pushed = state["writer"].push(payload)
+        except (OSError, ValueError):
+            return  # ring torn down mid-reply: driver failfast covers
+        if not pushed:
+            # Reply ring full or the reply exceeds a slot: deliver over
+            # the attach connection instead (server push) — a reply
+            # must never be dropped. The push coroutine needs the loop;
+            # strong-ref'd so the task can't be GC'd mid-push.
+            try:
+                self._loop.call_soon(
+                    lambda: self._spawn_ring_task(
+                        state["conn"].push("ring_completion", msg)))
+            except Exception:
+                pass
+
+    def _detach_task_ring(self, conn: ServerConnection) -> None:
+        state = conn.metadata.pop("task_ring", None)
+        if state is not None:
+            self._detach_task_ring_state(state)
+
+    def _detach_task_ring_state(self, state: dict) -> None:
+        if not state.get("live"):
+            return
+        state["live"] = False
+        try:
+            self._task_rings.remove(state)
+        except ValueError:
+            pass
+        poller = state.get("poller")
+        if poller is not None:
+            poller.cancel()
+        try:
+            self._loop.loop.remove_reader(state["reader"].doorbell_fd)
+        except Exception:
+            pass
+        state["reader"].close()
+        state["writer"].close()
+
+    async def on_client_disconnect(self, conn: ServerConnection) -> None:
+        """The driver that attached a task ring vanished: its segments
+        may be unlinked any moment — drop our end so the consumer never
+        touches a dead mapping. (In-flight executions still complete;
+        their replies fall back to the dead conn's push and vanish with
+        it, which is correct: the owner is gone.)"""
+        self._detach_task_ring(conn)
 
     async def _execute_streaming(self, spec: dict, actor: bool) -> dict:
 

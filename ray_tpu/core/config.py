@@ -168,17 +168,34 @@ _D("lease_batching", True,
 _D("lease_batch_max", 8,
    "Max leases requested in one batched lease RPC.")
 _D("submit_ring", False,
-   "Shared-memory submission ring between driver and local raylet: "
-   "task-spec deltas ride a fixed-slot SPSC shm ring (zero syscalls "
-   "per task steady-state; doorbell byte only on the empty->non-empty "
-   "edge) and the raylet forwards them to the leased worker. "
-   "Experimental: off by default; the RPC push path is the fallback "
-   "for every condition the ring cannot carry.")
+   "Worker-direct dispatch rings (round 10): when a lease grant "
+   "advertises ring capability and the leased worker is node-local, "
+   "the driver and the WORKER process attach a dedicated SPSC shm "
+   "ring pair — task-spec deltas ride the forward ring (zero "
+   "syscalls per task steady-state; doorbell byte only on the "
+   "empty->non-empty edge), replies (exec_us, attribution splits) "
+   "ride the twin ring. The raylet only brokers the lease; it never "
+   "sits on the per-task path (round 8's raylet-forwarded variant "
+   "lost that hop's latency back). Off by default; the RPC push path "
+   "is the byte-identical fallback for every condition a ring cannot "
+   "carry (non-local, oversize, full, streaming, setup failure).")
 _D("submit_ring_slots", 128,
    "Slot count of each submission/completion ring.")
 _D("submit_ring_slot_bytes", 8192,
    "Slot payload capacity; a spec delta larger than this falls back "
    "to the RPC push path.")
+_D("ring_backstop_poll_ms", 50.0,
+   "Base period of the ring consumers' lost-doorbell backstop poll. "
+   "Adaptive (ring.AdaptivePoll): holds this period while traffic "
+   "flows, backs off to 250 ms after 20 consecutive idle polls, "
+   "snaps back on traffic — the fixed 50 ms poll of round 8 both "
+   "wasted wakeups at idle and capped worst-case latency under a "
+   "lost doorbell.")
+_D("lease_return_batching", True,
+   "Batch worker-lease returns: one return_worker_leases RPC hands a "
+   "burst's finished leases back to the raylet (mirror of the "
+   "round-8 grant batch, coalesced through the same deferred-pump "
+   "discipline). Disabling restores one return_worker RPC per lease.")
 
 # -- tensor plane --------------------------------------------------------
 _D("tpu_slice_gang_scheduling", True,

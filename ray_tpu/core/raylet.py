@@ -22,8 +22,7 @@ from typing import Any, Dict, List, Optional
 from ray_tpu.core.config import ray_config
 from ray_tpu.core.gcs.client import GcsClient
 from ray_tpu.core.object_store import NativeObjectStore, make_store
-from ray_tpu.core.rpc import (RpcClient, RpcError, RpcServer,
-                              ServerConnection)
+from ray_tpu.core.rpc import RpcClient, RpcServer, ServerConnection
 
 logger = logging.getLogger(__name__)
 
@@ -47,6 +46,11 @@ class _Worker:
         self.log_offset = 0  # how far the log monitor has shipped
         self.lease_job_id: Optional[str] = None  # job of the active lease
         self.blocked = False  # task blocked in get(): CPU released
+        # A node-local driver attached a direct dispatch ring to this
+        # worker (round 10): pinned against idle recycling until the
+        # driver detaches — a returned worker must never carry a stale
+        # ring into another lease.
+        self.ring_attached = False
 
 
 class _Bundle:
@@ -963,6 +967,15 @@ class Raylet:
                             "resources": pending.demand,
                             "bundle": pending.bundle_key,
                             "chip_ids": chips,
+                            # Worker-direct dispatch rings (round 10):
+                            # the grant advertises that a NODE-LOCAL
+                            # driver may attach a driver<->worker ring
+                            # pair for this lease. Chip-holding and
+                            # actor leases are excluded (chip workers
+                            # retire at lease end; actors use their own
+                            # transport).
+                            "ring_capable": (not pending.is_actor
+                                             and not chips),
                         }})
                 made_progress = True
 
@@ -1088,6 +1101,26 @@ class Raylet:
                                    lease_id: str, worker_id: str,
                                    resources: Optional[Dict[str, float]]
                                    = None, dead: bool = False) -> bool:
+        self._return_worker_one(lease_id, worker_id, dead)
+        self._try_dispatch()
+        return True
+
+    async def handle_return_worker_leases(self, conn: ServerConnection, *,
+                                          returns: List[Dict[str, Any]]
+                                          ) -> bool:
+        """Batched lease returns (round 10, ROADMAP 4c): one RPC hands
+        back a burst's finished leases — the mirror of the round-8
+        grant batch. Each entry recycles through the same single-return
+        path; dispatch runs once for the whole batch."""
+        for item in returns or ():
+            self._return_worker_one(item.get("lease_id"),
+                                    item.get("worker_id"),
+                                    bool(item.get("dead")))
+        self._try_dispatch()
+        return True
+
+    def _return_worker_one(self, lease_id: Optional[str],
+                           worker_id: Optional[str], dead: bool) -> None:
         self._lease_conns.pop(lease_id, None)
         worker = self._workers.get(worker_id)
         if worker is not None and worker.lease_id == lease_id:
@@ -1096,6 +1129,14 @@ class Raylet:
             # would silently compute on its OLD chips while the raylet
             # leases them to someone else. Retire it instead.
             had_chips = bool(worker.chip_ids)
+            if worker.ring_attached:
+                # The lease came back while a dispatch ring is still
+                # attached (the driver died, or its detach was lost):
+                # the worker's consumer aliases segments that driver
+                # owns and will unlink — never recycle it into another
+                # lease; retire it instead.
+                worker.ring_attached = False
+                dead = True
             # The raylet's own bookkeeping is authoritative for what this
             # lease holds — not the client's view.
             self._release_lease_resources(worker)
@@ -1108,7 +1149,26 @@ class Raylet:
                 worker.state = "idle"
                 worker.actor_id = None
                 self._idle.append(worker)
-        self._try_dispatch()
+
+    # -- worker-direct dispatch rings (round 10; core/ring.py) ---------
+    # The raylet is OFF the per-task path: drivers attach ring pairs
+    # straight to the workers they lease. Its only ring duties are the
+    # capability bit on grants (_try_dispatch) and this pin/unpin, which
+    # keeps a still-ringed worker out of the idle pool (the driver-side
+    # pipeline counter pins the LEASE while slots are in flight; this
+    # covers the recycle-after-return edge).
+    async def handle_worker_ring_attached(self, conn: ServerConnection, *,
+                                          worker_id: str) -> bool:
+        w = self._workers.get(worker_id)
+        if w is not None:
+            w.ring_attached = True
+        return True
+
+    async def handle_worker_ring_detached(self, conn: ServerConnection, *,
+                                          worker_id: str) -> bool:
+        w = self._workers.get(worker_id)
+        if w is not None:
+            w.ring_attached = False
         return True
 
     async def handle_mark_actor_worker(self, conn: ServerConnection, *,
@@ -1391,138 +1451,11 @@ class Raylet:
                 n += 1
         return n
 
-    # ------------------------------------------------------------------
-    # shared-memory submission ring (round 8; core/ring.py)
-    # ------------------------------------------------------------------
-    async def handle_attach_submit_ring(self, conn: ServerConnection, *,
-                                        sub_name: str, sub_fifo: str,
-                                        comp_name: str, comp_fifo: str
-                                        ) -> bool:
-        """A node-local driver created a ring pair (it owns the segments
-        and FIFOs): attach the submit side as consumer, the completion
-        side as producer, and wake on the submit doorbell. Task-spec
-        deltas dequeued here are forwarded to the worker the DRIVER
-        leased (the lease plane is untouched — the ring replaces only
-        the driver->worker push hop with driver->shm->raylet->worker,
-        trading the driver's per-task socket write for plain stores)."""
-        from ray_tpu.core.ring import RingReader, RingWriter
-
-        self._detach_submit_ring(conn)
-        state = {
-            "reader": RingReader(sub_name, sub_fifo),
-            "writer": RingWriter(comp_name, comp_fifo),
-            "templates": {},
-            "conn": conn,
-        }
-        conn.metadata["submit_ring"] = state
-        loop = asyncio.get_running_loop()
-        loop.add_reader(state["reader"].doorbell_fd,
-                        self._on_ring_doorbell, state)
-        state["poller"] = asyncio.ensure_future(self._ring_backstop(state))
-        return True
-
-    async def handle_register_spec_template(self, conn: ServerConnection,
-                                            *, template_id: int,
-                                            base: dict) -> bool:
-        """Invariant wire dict of a spec template, registered once per
-        (fn, options, env) shape; ring deltas reference it by id so the
-        steady-state entry carries only per-call fields."""
-        state = conn.metadata.get("submit_ring")
-        if state is None:
-            raise RpcError("no submission ring attached on this "
-                           "connection")
-        while len(state["templates"]) >= 1024:
-            # Evict OLDEST-first (insertion order), never wholesale:
-            # the driver's own map clears at 512 and re-registers under
-            # fresh monotonic ids, so any id the driver still holds is
-            # among the newest <=512 registrations — evicting from the
-            # old end can therefore never invalidate a live id, while
-            # keeping this per-connection registry bounded.
-            state["templates"].pop(next(iter(state["templates"])))
-        state["templates"][int(template_id)] = base
-        return True
-
-    def _on_ring_doorbell(self, state: dict) -> None:
-        try:
-            drained = state["reader"].drain()
-        except (OSError, ValueError):
-            return  # ring torn down under the callback
-        for raw in drained:
-            asyncio.ensure_future(self._dispatch_ring_task(state, raw))
-
-    async def _ring_backstop(self, state: dict) -> None:
-        """Lost-wakeup backstop (ring.py module docstring): re-check the
-        ring on a coarse timer so a doorbell lost to the cross-process
-        publish race costs one poll period, not a hang."""
-        from ray_tpu.core.ring import BACKSTOP_POLL_S
-
-        while not state["reader"].closed:
-            await asyncio.sleep(BACKSTOP_POLL_S)
-            try:
-                self._on_ring_doorbell(state)
-            except Exception:
-                return  # ring torn down under us
-
-    async def _dispatch_ring_task(self, state: dict, raw: bytes) -> None:
-        import msgpack
-
-        delta = msgpack.unpackb(raw, raw=False)
-        task_id = delta.get("task_id")
-        try:
-            base = state["templates"].get(delta.pop("t", None))
-            worker_id = delta.pop("w", None)
-            if base is None:
-                raise RpcError("unknown spec template")
-            spec = dict(base)
-            spec.update(delta)
-            worker = self._workers.get(worker_id)
-            if (worker is None or worker.address is None
-                    or worker.proc.poll() is not None):
-                raise RpcError("leased worker is gone")
-            client = await self._worker_client(worker.address)
-            reply = await client.call("push_task", spec=spec,
-                                      timeout=None)
-            self._ring_complete(state, {"task_id": task_id,
-                                        "reply": reply})
-        except Exception as e:  # noqa: BLE001
-            # A typed completion error: the driver maps it onto the same
-            # ConnectionLost/retry path a failed RPC push takes.
-            self._ring_complete(state, {
-                "task_id": task_id,
-                "error": f"{type(e).__name__}: {e}"})
-
-    def _ring_complete(self, state: dict, msg: dict) -> None:
-        import msgpack
-
-        payload = msgpack.packb(msg, use_bin_type=True)
-        if not state["writer"].push(payload):
-            # Completion ring full or the reply exceeds a slot: deliver
-            # over the attach connection instead (server push) — a
-            # completion must never be dropped.
-            asyncio.ensure_future(
-                state["conn"].push("ring_completion", msg))
-
-    def _detach_submit_ring(self, conn: ServerConnection) -> None:
-        state = conn.metadata.pop("submit_ring", None)
-        if state is None:
-            return
-        poller = state.get("poller")
-        if poller is not None:
-            poller.cancel()
-        try:
-            asyncio.get_running_loop().remove_reader(
-                state["reader"].doorbell_fd)
-        except Exception:
-            pass
-        state["reader"].close()
-        state["writer"].close()
-
     async def on_client_disconnect(self, conn: ServerConnection) -> None:
         """Drop queued lease requests from a vanished client so a later
         grant doesn't strand a worker + its resources, and reclaim
         leases it was already granted (a dead client can never use or
         return them)."""
-        self._detach_submit_ring(conn)
         for pending in [p for p in self._pending if p.conn is conn]:
             self._pending.remove(pending)
             if not pending.future.done():

@@ -4,10 +4,14 @@ Reference intuition: the zero-syscall submission queues of io_uring /
 virtio — producer and consumer share a fixed-slot ring in mapped memory;
 publishing an entry is a pair of plain stores, and the *only* syscall is
 a doorbell written on the empty→non-empty edge to wake a sleeping
-consumer. Here the ring carries task-spec deltas between a driver and
-its node-local raylet (`cluster_runtime._push_via_ring` →
-`raylet._drain_submit_ring`), with a twin ring carrying completions
-back.
+consumer. Here the rings carry task-spec deltas from a driver straight
+to the *worker process* it leased (round 10: `cluster_runtime.
+_worker_ring_enqueue` → the worker's `handle_attach_task_ring`
+consumer), with a twin ring carrying replies — including `exec_us` and
+the attribution split — back. The raylet only brokers the lease (its
+grant advertises ring capability); it never sits on the per-task path,
+which is what round 8's raylet-forwarded variant lost to direct TCP
+push.
 
 Layout of the shm segment (one ring per segment; reuses the raw
 `shm_open+mmap` attach machinery of `object_store.attach_segment`, so
@@ -36,9 +40,14 @@ writes — zero syscalls per task. The consumer registers the FIFO fd
 with its event loop, drains the FIFO and then the ring on wakeup.
 There is a textbook lost-wakeup window (consumer drains to empty while
 the producer concurrently pushes and judges the ring non-empty from a
-stale head); consumers close it with a coarse backstop poll
-(`BACKSTOP_POLL_S`) rather than a cross-process fence — a 50 ms blip on
-a nanosecond-wide race, and the hot loop stays syscall-free.
+stale head); consumers close it with a coarse backstop poll rather
+than a cross-process fence — a bounded blip on a nanosecond-wide race,
+and the hot loop stays syscall-free. The poll is *adaptive*
+(`AdaptivePoll`): it runs at `ring_backstop_poll_ms` while traffic
+flows (bounding the worst-case latency of a lost doorbell), backs off
+to `IDLE_POLL_S` after `IDLE_POLLS_TO_BACKOFF` consecutive empty
+polls (an idle ring must not burn 20 wakeups/s forever), and snaps
+back to the base period the moment a poll or doorbell finds traffic.
 """
 
 from __future__ import annotations
@@ -58,7 +67,49 @@ _CLOSED_OFF = 24
 
 # Consumers sleep at most this long before re-checking the ring even
 # without a doorbell (lost-wakeup backstop; see module docstring).
+# Kept as the blocking-helper default; the event-loop backstops pace
+# themselves with AdaptivePoll below.
 BACKSTOP_POLL_S = 0.05
+
+# Adaptive-backstop bounds: after IDLE_POLLS_TO_BACKOFF consecutive
+# empty polls the period backs off to IDLE_POLL_S; any traffic snaps it
+# back to the configured base (ring_backstop_poll_ms).
+IDLE_POLL_S = 0.25
+IDLE_POLLS_TO_BACKOFF = 20
+
+
+def backstop_poll_s() -> float:
+    """Base backstop period from config (`ring_backstop_poll_ms`)."""
+    from ray_tpu.core.config import ray_config
+
+    return max(0.001, ray_config().ring_backstop_poll_ms / 1000.0)
+
+
+class AdaptivePoll:
+    """Backstop pacing for ring consumers (see module docstring): the
+    fixed 50 ms poll of round 8 both wasted wakeups at idle and set the
+    worst-case lost-doorbell latency. This keeps the base period while
+    traffic flows and decays to `IDLE_POLL_S` once `observe()` reports
+    `IDLE_POLLS_TO_BACKOFF` consecutive empty drains; any non-empty
+    drain snaps the period back."""
+
+    def __init__(self, base_s: Optional[float] = None):
+        self.base_s = base_s if base_s is not None else backstop_poll_s()
+        self._idle_polls = 0
+
+    @property
+    def interval(self) -> float:
+        if self._idle_polls >= IDLE_POLLS_TO_BACKOFF:
+            return max(IDLE_POLL_S, self.base_s)
+        return self.base_s
+
+    def observe(self, drained: int) -> None:
+        """Report how many entries the poll (or a doorbell wakeup
+        between polls) found."""
+        if drained > 0:
+            self._idle_polls = 0
+        else:
+            self._idle_polls += 1
 
 
 def ring_bytes(nslots: int, slot_bytes: int) -> int:

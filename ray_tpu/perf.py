@@ -6,6 +6,9 @@ reference budgets at 50-300 µs/task (SURVEY §3.2). Run directly:
     python -m ray_tpu.perf              # cluster mode (multi-process)
     python -m ray_tpu.perf --local     # local mode (in-process)
     python -m ray_tpu.perf --attribute # + submit-path breakdown
+    python -m ray_tpu.perf --ring      # worker-direct dispatch rings
+                                       # (tasks_ring_per_s + honesty
+                                       # counters, round 10)
 
 `--attribute` turns on the per-call attribution profiler
 (core/attribution.py) for the driver AND every worker it spawns, then
@@ -260,6 +263,68 @@ def run_microbench(local_mode: bool = False,
     return out
 
 
+def run_ring_microbench(scale: float = 1.0,
+                        rounds: int = 3) -> Dict[str, Any]:
+    """Worker-direct dispatch ring bench (round 10): boots its OWN
+    cluster with `submit_ring` on (the flag snapshots at runtime
+    construction), measures the remote tiny-task burst riding the
+    driver->worker rings, and reports the honesty counters next to the
+    rate: enqueues vs doorbells (the steady-state zero-syscall claim —
+    doorbells must be ≪ enqueues under load), replies that came back
+    over the twin ring, and fallbacks (zero on the happy path).
+    Fold-best of `rounds` bursts, same convention as the perf guards.
+
+    Returns:
+      tasks_ring_per_s  : remote tiny-task rate over the rings
+      ring_enq / ring_doorbell / ring_reply / ring_fallback : counters
+      ring_engaged      : at least one live driver<->worker pair
+    """
+    import os
+
+    import ray_tpu
+    from ray_tpu.core import attribution
+    from ray_tpu.core.config import ray_config
+
+    ray_tpu.shutdown()
+    saved_cfg = dict(ray_config()._values)
+    prev_attr = attribution.enabled
+    attribution.enable()
+    ncpu = min(4, max(2, os.cpu_count() or 1))
+    ray_tpu.init(num_cpus=ncpu, _system_config={
+        "submit_ring": True, "task_inline_execution": False})
+    out: Dict[str, Any] = {}
+    try:
+        noop = ray_tpu.remote(_noop)
+        ray_tpu.get([noop.remote() for _ in range(10)], timeout=120)
+        attribution.reset()
+        n = max(1, int(1000 * scale))
+        best = 0.0
+        for _ in range(max(1, rounds)):
+            t0 = time.perf_counter()
+            ray_tpu.get([noop.remote() for _ in range(n)], timeout=300)
+            best = max(best, n / (time.perf_counter() - t0))
+        out["tasks_ring_per_s"] = round(best, 1)
+        snap = attribution.snapshot()
+        for label, key in (("ring.direct_enq", "ring_enq"),
+                           ("ring.doorbell", "ring_doorbell"),
+                           ("ring.reply", "ring_reply"),
+                           ("ring.fallback", "ring_fallback")):
+            out[key] = snap.get(label, {}).get("count", 0)
+        rt = ray_tpu.core.worker.current_runtime()
+        out["ring_engaged"] = any(
+            isinstance(st, dict) and st.get("live")
+            for st in rt._worker_rings.values())
+    finally:
+        ray_tpu.shutdown()
+        if not prev_attr:
+            attribution.disable()
+        # _system_config overrides land in the process-global Config:
+        # restore so a later init in this process gets its own flags.
+        ray_config()._values.clear()
+        ray_config()._values.update(saved_cfg)
+    return out
+
+
 def run_llm_serve_bench(scale: float = 1.0) -> Dict[str, Any]:
     """LLM-serving scenario: the continuous-batching engine vs the
     `@serve.batch`-style static policy on the SAME mixed-length
@@ -411,11 +476,19 @@ def main() -> None:
                    help="run ONLY the in-process LLM-serving scenario "
                         "(continuous vs static batching, TTFT, 2x-"
                         "overload shedding); no cluster is booted")
+    p.add_argument("--ring", action="store_true",
+                   help="run ONLY the worker-direct dispatch-ring "
+                        "bench (boots a ring-enabled cluster, measures "
+                        "tasks_ring_per_s + the enqueue/doorbell/"
+                        "fallback honesty counters)")
     args = p.parse_args()
     import ray_tpu
 
     if args.llm_serve:
         print(json.dumps(run_llm_serve_bench(scale=args.scale)))
+        return
+    if args.ring:
+        print(json.dumps(run_ring_microbench(scale=args.scale)))
         return
 
     result = run_microbench(local_mode=args.local, scale=args.scale,

@@ -1,0 +1,162 @@
+"""Cluster integration: round-10 worker-direct dispatch rings.
+
+Lifecycle edges ISSUE 10 pins down: a worker killed mid-ring drains to
+the typed retry path with no lost or duplicated task (task_events:
+exactly one SUBMITTED per task), an oversize spec falls back to the RPC
+push on a ring-attached lease (and the pair survives), a lease return
+detaches and destroys the pair (segments unlinked), and flag-off
+restores pure RPC push (no pair ever attaches).
+
+One module-scoped ring cluster serves the first three tests (ordered so
+the worker-kill chaos runs last on it); flag-off boots its own.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.config import ray_config
+
+pytestmark = pytest.mark.cluster
+
+
+def _live_rings(rt):
+    return [st for st in rt._worker_rings.values()
+            if isinstance(st, dict) and st.get("live")]
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _restore_config():
+    """_system_config overrides land in the process-global Config and
+    would otherwise leak into later test modules (e.g. re-gate the
+    inline tier off for the fastpath suite)."""
+    saved = dict(ray_config()._values)
+    yield
+    ray_config()._values.clear()
+    ray_config()._values.update(saved)
+
+
+@pytest.fixture(scope="module")
+def ring_cluster(_restore_config):
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, _system_config={
+        "submit_ring": True, "task_inline_execution": False,
+        "task_retry_delay_ms": 50})
+    yield ray_tpu.core.worker.current_runtime()
+    ray_tpu.shutdown()
+
+
+def test_oversize_spec_falls_back_to_rpc_push(ring_cluster):
+    """A delta larger than the slot capacity cannot ride the ring: the
+    push must fall back to the RPC path on the SAME ring-attached
+    lease, and the pair keeps serving small specs afterwards."""
+    from ray_tpu.core import attribution
+
+    rt = ring_cluster
+
+    @ray_tpu.remote
+    def size_of(b):
+        return len(b)
+
+    ray_tpu.get([size_of.remote(b"x") for _ in range(30)], timeout=120)
+    assert _live_rings(rt), rt._worker_rings
+    attribution.enable()
+    attribution.reset()
+    try:
+        big = b"y" * (8 * ray_config().submit_ring_slot_bytes)
+        assert ray_tpu.get(size_of.remote(big), timeout=60) == len(big)
+        snap = attribution.snapshot()
+        assert snap.get("ring.fallback", {}).get("count", 0) >= 1, snap
+    finally:
+        attribution.disable()
+    assert _live_rings(rt)
+    assert ray_tpu.get(size_of.remote(b"z"), timeout=60) == 1
+
+
+def test_lease_return_detaches_and_destroys_pair(ring_cluster):
+    """An idle lease lingers briefly then returns; the return must
+    detach the pair and unlink both shm segments — a recycled worker
+    never carries a stale ring into its next lease."""
+    rt = ring_cluster
+
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    ray_tpu.get([one.remote() for _ in range(30)], timeout=120)
+    live = _live_rings(rt)
+    assert live
+    segs = [name for st in live for name, _ in st["files"]]
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and _live_rings(rt):
+        time.sleep(0.2)
+    assert not _live_rings(rt), rt._worker_rings
+    for name in segs:
+        assert not os.path.exists(f"/dev/shm/{name}"), name
+
+
+def test_worker_kill_mid_ring_drains_to_retry_path(ring_cluster):
+    """Chaos edge (runs last on the shared cluster): SIGKILL a
+    ring-attached worker with a burst in flight. Its ring entries must
+    fail onto the ConnectionLost retry path (same as a dead RPC push)
+    and re-lease elsewhere — every submission completes, none is lost,
+    none is duplicated."""
+    rt = ring_cluster
+
+    @ray_tpu.remote
+    def pid_add(x):
+        return (os.getpid(), x + 1)
+
+    warm = ray_tpu.get([pid_add.remote(i) for i in range(40)],
+                       timeout=120)
+    pids = sorted({p for p, _ in warm})
+    assert _live_rings(rt), rt._worker_rings
+
+    refs = [pid_add.remote(i) for i in range(200)]
+    time.sleep(0.05)          # let part of the burst go in flight
+    os.kill(pids[0], signal.SIGKILL)
+    res = ray_tpu.get(refs, timeout=180)
+    assert [x for _, x in res] == [i + 1 for i in range(200)]
+
+    # Exactly-once submission accounting survives the chaos: one
+    # SUBMITTED event per task (retries re-EXECUTE, never re-submit).
+    task_ids = {r.id().task_id().hex() for r in refs}
+    deadline = time.monotonic() + 15
+    counts = {}
+    while time.monotonic() < deadline:
+        counts = {}
+        for e in rt.task_events():
+            if (e.get("task_id") in task_ids
+                    and e.get("event") == "SUBMITTED"):
+                counts[e["task_id"]] = counts.get(e["task_id"], 0) + 1
+        if len(counts) == len(task_ids):
+            break
+        time.sleep(0.5)
+    assert len(counts) == len(task_ids)
+    assert all(n == 1 for n in counts.values()), {
+        t: n for t, n in counts.items() if n != 1}
+
+
+def test_flag_off_restores_pure_rpc_push():
+    """Default config: no pair ever attaches; dispatch is the plain
+    RPC push, byte-identically to round 8's flag-off contract."""
+    ray_tpu.shutdown()
+    # submit_ring: False explicitly — _system_config overrides persist
+    # in the process-global Config across shutdown/init cycles.
+    ray_tpu.init(num_cpus=2, _system_config={
+        "submit_ring": False, "task_inline_execution": False})
+    try:
+        @ray_tpu.remote
+        def dbl(x):
+            return x * 2
+
+        assert ray_tpu.get([dbl.remote(i) for i in range(30)],
+                           timeout=120) == [i * 2 for i in range(30)]
+        rt = ray_tpu.core.worker.current_runtime()
+        assert rt._worker_rings == {}
+        assert rt._task_rings == []
+    finally:
+        ray_tpu.shutdown()

@@ -139,7 +139,8 @@ def test_disabling_inline_restores_pure_remote(cluster):
 
 def test_submit_ring_end_to_end_parity():
     # Own cluster: the ring is flag-gated and the flag snapshots at
-    # runtime construction.
+    # runtime construction. Round 10: rings are worker-direct — the
+    # driver attaches a pair straight to each leased worker.
     ray_tpu.shutdown()
     ray_tpu.init(num_cpus=2, _system_config={
         "submit_ring": True, "task_inline_execution": False})
@@ -155,8 +156,10 @@ def test_submit_ring_end_to_end_parity():
         assert ray_tpu.get([add.remote(i, 1) for i in range(50)],
                            timeout=120) == [i + 1 for i in range(50)]
         rt = ray_tpu.core.worker.current_runtime()
-        # The ring actually engaged (not silently falling back forever).
-        assert isinstance(rt._ring, dict), rt._ring
+        # Worker-direct rings actually engaged (not silently falling
+        # back forever): at least one live driver<->worker pair.
+        assert any(isinstance(st, dict) and st.get("live")
+                   for st in rt._worker_rings.values()), rt._worker_rings
         with pytest.raises(RuntimeError, match="ring-kapow"):
             ray_tpu.get(boom.remote(), timeout=60)
         # Refs produced over the ring stay first-class.

@@ -265,6 +265,9 @@ class _FakeProc:
     def poll(self):
         return None
 
+    def terminate(self):
+        pass
+
 
 def _batch_raylet(idle_workers: int, cpu: float = 4.0):
     from ray_tpu.core.raylet import Raylet, _Worker
@@ -501,5 +504,120 @@ def test_enqueue_racing_the_drain_tail_is_not_stranded():
         assert rt.submitted == ["first", "late"]
         assert rt._submit_drain_scheduled is False
         assert rt._loop.wakeups == 1
+
+    _run(main())
+
+
+# ---------------------------------------------------------------------------
+# round 10: adaptive ring backstop + batched lease returns + ring pinning
+# ---------------------------------------------------------------------------
+def test_adaptive_backstop_poll_backs_off_and_snaps_back():
+    from ray_tpu.core.ring import (AdaptivePoll, IDLE_POLL_S,
+                                   IDLE_POLLS_TO_BACKOFF)
+
+    p = AdaptivePoll(base_s=0.05)
+    assert p.interval == 0.05
+    for _ in range(IDLE_POLLS_TO_BACKOFF - 1):
+        p.observe(0)
+    assert p.interval == 0.05          # not yet: one poll short
+    p.observe(0)
+    assert p.interval == IDLE_POLL_S   # idle threshold reached
+    p.observe(0)
+    assert p.interval == IDLE_POLL_S   # stays backed off while idle
+    p.observe(3)
+    assert p.interval == 0.05          # traffic snaps back immediately
+
+
+class _ReturnHarness(ClusterRuntime):
+    """Lease-return batching only; the raylet RPC is an in-process
+    recorder."""
+
+    def __init__(self, batching: bool = True):
+        self._worker_rings = {}
+        self._pending_lease_returns = {}
+        self._lease_return_batching = batching
+        self._ring_bg_tasks = set()
+        self.calls = []
+        outer = self
+
+        class _Client:
+            async def call(self, method, **kw):
+                outer.calls.append((method, kw))
+                return True
+
+        self._client = _Client()
+
+    async def _raylet_client(self, address, connect_timeout=10.0):
+        return self._client
+
+
+def _lease(i):
+    return {"lease_id": f"l{i}", "worker_id": f"w{i}",
+            "resources": {"CPU": 1.0}, "raylet_address": "raylet:1"}
+
+
+def test_burst_of_returns_coalesces_to_one_rpc():
+    async def main():
+        rt = _ReturnHarness()
+        await asyncio.gather(*(rt._return_worker(_lease(i))
+                               for i in range(5)))
+        # One deferred-pump flush carried the whole burst.
+        assert len(rt.calls) == 1
+        method, kw = rt.calls[0]
+        assert method == "return_worker_leases"
+        assert [it["lease_id"] for it in kw["returns"]] == [
+            f"l{i}" for i in range(5)]
+
+    _run(main())
+
+
+def test_single_return_stays_on_the_plain_rpc():
+    async def main():
+        rt = _ReturnHarness()
+        await rt._return_worker(_lease(0), dead=True)
+        assert len(rt.calls) == 1
+        method, kw = rt.calls[0]
+        # A lone return (and any old-peer path) keeps the round-8 wire
+        # shape; the batch RPC only fires for genuine bursts.
+        assert method == "return_worker"
+        assert kw["lease_id"] == "l0" and kw["dead"] is True
+
+    _run(main())
+
+
+def test_return_batching_disabled_restores_per_lease_rpcs():
+    async def main():
+        rt = _ReturnHarness(batching=False)
+        await asyncio.gather(*(rt._return_worker(_lease(i))
+                               for i in range(3)))
+        assert [m for m, _ in rt.calls] == ["return_worker"] * 3
+
+    _run(main())
+
+
+def test_raylet_batched_returns_recycle_and_ring_pin_retires():
+    r = _batch_raylet(idle_workers=2)
+
+    async def main():
+        client = LoopbackClient(r)
+        await client.connect(handshake=False)
+        reply = await client.call("request_worker_leases",
+                                  req=_lease_req_wire(count=2))
+        grants = reply["grants"]
+        assert len(grants) == 2
+        # Round 10: chip-less task grants advertise ring capability.
+        assert all(g["ring_capable"] for g in grants)
+        # One worker still ring-attached at return time (driver died or
+        # its detach was lost): it must retire, never recycle — the
+        # other recycles to idle as before. One batched RPC covers both.
+        r._workers[grants[0]["worker_id"]].ring_attached = True
+        await client.call("return_worker_leases", returns=[
+            {"lease_id": g["lease_id"], "worker_id": g["worker_id"],
+             "dead": False} for g in grants])
+        w0 = r._workers[grants[0]["worker_id"]]
+        w1 = r._workers[grants[1]["worker_id"]]
+        assert w0.state == "dead" and not w0.ring_attached
+        assert w1.state == "idle"
+        assert r.resources_available["CPU"] == 4.0
 
     _run(main())
