@@ -70,7 +70,7 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Dict
+from typing import Any, Dict
 
 ENV_FLAG = "RAY_TPU_ATTRIBUTION"
 
@@ -100,6 +100,10 @@ def disable() -> None:
 def reset() -> None:
     with _lock:
         _stats.clear()
+        # Value-label markers are part of the recorded state: a label
+        # reused as a duration after reset must not keep rendering in
+        # sample units.
+        _value_labels.clear()
 
 
 def record(label: str, dt: float) -> None:
@@ -159,8 +163,24 @@ def snapshot() -> Dict[str, Dict[str, float]]:
     return out
 
 
-def fold(remote: Dict[str, float], prefix: str = "worker.") -> None:
-    """Fold a worker-reported {label: seconds-or-us} fragment into the
-    local table (labels arrive already in microseconds as ints)."""
+def fold(remote: Dict[str, Any], prefix: str = "worker.") -> None:
+    """Fold a worker-reported fragment into the local table.
+
+    Duration entries arrive as microsecond ints: ``{label: us}``.
+    Dimensionless entries (worker-side `value()` samples) MUST arrive
+    marked — ``{label: [sample, "v"]}``, built with `value_marked` —
+    because `_value_labels` is process-local: an unmarked sample folded
+    from a worker fragment would render as microseconds in the owner's
+    `snapshot()`."""
     for label, us in remote.items():
-        record(prefix + label, us / 1e6)
+        if isinstance(us, (list, tuple)):
+            # (sample, "v") marker: a dimensionless value() sample.
+            value(prefix + label, us[0])
+        else:
+            record(prefix + label, us / 1e6)
+
+
+def value_marked(v: float) -> list:
+    """Wrap a dimensionless sample for a cross-process fragment so
+    `fold()` on the receiving side keeps its units (see `fold`)."""
+    return [v, "v"]

@@ -28,7 +28,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import cloudpickle
 import msgpack
 
-from ray_tpu.core import attribution, serialization
+from ray_tpu.core import attribution, flight, serialization
 from ray_tpu.core.config import ray_config
 from ray_tpu.core.function_manager import FunctionManager
 from ray_tpu.core.gcs.client import GcsClient
@@ -236,6 +236,40 @@ class ClusterRuntime:
         self._ring_slots = cfg.submit_ring_slots
         self._ring_slot_bytes = cfg.submit_ring_slot_bytes
         self._lease_return_batching = cfg.lease_return_batching
+        # Flight recorder (round 12): always-on event ring + loop-lag
+        # watchdog on this process's RPC loop. The config flag gates
+        # the whole subsystem per process (workers read it through the
+        # inherited RAY_TPU_FLIGHT_RECORDER env; _system_config applies
+        # driver-side only).
+        if not cfg.flight_recorder:
+            flight.enabled = False
+        if flight.enabled:
+            # Workers/raylets inherit RAY_TPU_LOG_DIR; the head driver
+            # owns the session and points its reports at the same logs
+            # dir, so every process's stall reports land together.
+            flight.configure(capacity=cfg.flight_events,
+                             stall_threshold_ms=cfg.stall_threshold_ms,
+                             heartbeat_ms=cfg.flight_heartbeat_ms,
+                             report_dir=(node.log_dir if node is not None
+                                         else None))
+            flight.set_role(mode, worker_id=self.worker_id.hex(),
+                            node_id=node_id)
+            flight.install_gc_hook()
+            self._flight_watch = flight.watch_loop(
+                self._loop.loop, name=f"{mode}-loop")
+            if mode == "driver":
+                # Workers reach the merged timeline through their
+                # raylet's registration table; a driver must announce
+                # itself or its submit-side ring (and its stall
+                # episodes) never show up at /api/timeline.
+                try:
+                    self._loop.run(self._raylet.notify(
+                        "register_flight_source", address=self.address),
+                        timeout=5)
+                except Exception:
+                    pass  # observability must not fail bring-up
+        else:
+            self._flight_watch = None
         # Per-function exec-time EMA (seconds), fed by exec_us riding
         # every task reply and by inline runs; the inline gate admits
         # only functions whose EMA is KNOWN and below the threshold, so
@@ -555,6 +589,10 @@ class ClusterRuntime:
         if self._shutdown:
             return
         self._shutdown = True
+        if self._flight_watch is not None:
+            # Stop the heartbeat before the loop dies: a stale entry
+            # would read as a permanent stall to the watchdog thread.
+            flight.unwatch_loop(self._flight_watch)
         try:
             from ray_tpu.util.metrics import stop_metrics_push
 
@@ -1262,6 +1300,9 @@ class ClusterRuntime:
                                        args, kwargs)
         if attribution.enabled:
             attribution.count("submit.remote")
+        if flight.enabled:
+            flight.instant("task", "submit",
+                           arg=remote_function._function_name)
         task_id = TaskID.for_task(self.job_id)
         streaming = opts.num_returns in ("streaming", "dynamic")
         num_returns = 1 if streaming else opts.num_returns
@@ -1349,7 +1390,10 @@ class ClusterRuntime:
                 or opts.accelerator_type):
             return False
         md = opts._metadata
-        if md is not None and md.get("inline") is False:
+        if md is not None and (md.get("inline") is False
+                               or md.get("profile")):
+            # Profiled tasks always go remote: the pstats dump belongs
+            # next to a WORKER log where /api/logs can surface it.
             return False
         for a in args:
             if isinstance(a, ObjectRef) and not self._resolved_locally(a):
@@ -1482,11 +1526,13 @@ class ClusterRuntime:
         # cacheable via their hash.
         cacheable = not raw_env or set(raw_env) <= {"env_vars"}
         resources = resource_demand(opts)
+        md = getattr(opts, "_metadata", None)
+        profile = bool(md and md.get("profile"))
         tkey = (fn_key, num_returns, streaming, opts.max_retries,
                 env_hash(raw_env) if raw_env else "",
                 _pg_id_of(getattr(opts, "placement_group", None)),
                 getattr(opts, "placement_group_bundle_index", -1),
-                tuple(sorted(resources.items())))
+                tuple(sorted(resources.items())), profile)
         hit = self._spec_templates.get(tkey) if cacheable else None
         if hit is None:
             env = _prepared_env(self, opts)
@@ -1510,6 +1556,7 @@ class ClusterRuntime:
                 pg=(None if pg is None else {
                     "pg_id": pg, "bundle_index": tkey[6]}),
                 trace_ctx=trace_ctx,
+                profile=profile or None,
             )
             sched_key = self._sched_key_of(proto)
             hit = (SpecTemplate(proto), sched_key)
@@ -1754,9 +1801,14 @@ class ClusterRuntime:
         key = sched_key if sched_key is not None else self._sched_key_of(
             spec)
         _t0 = time.perf_counter() if attribution.enabled else 0.0
+        _m0 = time.monotonic() if flight.enabled else 0.0
         worker = await self._acquire_worker(key, spec["resources"], pg=pg)
         if attribution.enabled:
             attribution.record("submit.lease", time.perf_counter() - _t0)
+        if flight.enabled:
+            flight.record("lease", "acquire",
+                          dur_us=int((time.monotonic() - _m0) * 1e6),
+                          arg=worker.get("worker_id", "")[:8], t=_m0)
         if spec["task_id"] in self._cancel_requested:
             # Cancelled while queued for a lease: never push.
             self._offer_worker(key, worker)
@@ -1844,6 +1896,9 @@ class ClusterRuntime:
                              else 0.7 * prev + 0.3 * rtt)
         if attribution.enabled:
             attribution.record("submit.push_rtt", rtt)
+        if flight.enabled:
+            flight.record("task", "push_rtt", dur_us=int(rtt * 1e6),
+                          arg=spec.get("name"), t=push_t0)
         # Feed the inline cost model: exec_us rides every task reply (a
         # single int), so the EMA converges to the TRUE exec time — a
         # function that went remote because of one slow run can earn
@@ -2008,6 +2063,8 @@ class ClusterRuntime:
             return None
         if attribution.enabled:
             attribution.count("ring.direct_enq")
+        if flight.enabled:
+            flight.instant("ring", "direct_enq")
         return fut
 
     def _drain_worker_ring(self, st: dict) -> int:
@@ -2575,6 +2632,8 @@ class ClusterRuntime:
                 "dead": dead}
         address = worker["raylet_address"]
         if not self._lease_return_batching:
+            if flight.enabled:
+                flight.instant("lease", "return", arg=1)
             await self._send_lease_returns(address, [item])
             return
         # Batched lease returns (round 10, ROADMAP 4c): a burst's
@@ -2599,6 +2658,8 @@ class ClusterRuntime:
             return
         if attribution.enabled and len(batch["items"]) > 1:
             attribution.value("lease.return_batch", len(batch["items"]))
+        if flight.enabled:
+            flight.instant("lease", "return", arg=len(batch["items"]))
         try:
             await self._send_lease_returns(address, batch["items"])
         finally:
@@ -3461,6 +3522,16 @@ class ClusterRuntime:
     async def handle_ping(self, conn: ServerConnection) -> str:
         return "pong"
 
+    async def handle_dump_flight_record(
+            self, conn: ServerConnection, *,
+            window_s: Optional[float] = None,
+            include_events: bool = True) -> dict:
+        """This process's flight-recorder ring + stall episodes (the
+        raylet's fan-out handler of the same name collects these from
+        every worker on its node; the dashboard merges nodes)."""
+        return flight.dump(window_s=window_s,
+                           include_events=include_events)
+
     # ==================================================================
     # worker-mode execution (reference: core_worker.cc:2596 ExecuteTask +
     # _raylet.pyx task_execution_handler)
@@ -3516,6 +3587,39 @@ class ClusterRuntime:
         kwargs = {k: self.get(v) if isinstance(v, ObjectRef) else v
                   for k, v in kwargs.items()}
         return args, kwargs, arg_refs
+
+    def _dump_task_profile(self, profiler, task_id: str,
+                           name: str) -> None:
+        """Per-task cProfile dump (off unless the call site opted in
+        with `.options(_metadata={"profile": True})`). The pstats text
+        lands in two places: a file next to this worker's log (same
+        directory the raylet tails for `/api/logs`), and — top lines
+        only — on stdout, i.e. IN the worker log itself, so the
+        existing log surfaces point at the full dump. Profiling output
+        must never fail the task."""
+        try:
+            import io
+            import pstats
+
+            buf = io.StringIO()
+            stats = pstats.Stats(profiler, stream=buf)
+            stats.sort_stats("cumulative").print_stats(30)
+            text = buf.getvalue()
+            # Same resolution as the stall reports: RAY_TPU_LOG_DIR
+            # when inherited (the raylet's log dir — where /api/logs
+            # reads), created if missing.
+            log_dir = flight.report_dir()
+            wid = (self._raylet_worker_id or self.worker_id.hex())[:8]
+            path = os.path.join(
+                log_dir, f"worker-{wid}-profile-{task_id[:8]}.pstats.txt")
+            with open(path, "w") as f:
+                f.write(f"# task {name} ({task_id})\n")
+                f.write(text)
+            head = "\n".join(text.splitlines()[:12])
+            print(f"[profile] task {name} ({task_id[:8]}) -> {path}\n"
+                  f"{head}", flush=True)
+        except Exception:
+            logger.debug("task profile dump failed", exc_info=True)
 
     def _commit_arg_borrows(self, arg_refs) -> None:
         """Upgrade still-held arg-ref pins to owner-registered borrows.
@@ -3674,6 +3778,14 @@ class ClusterRuntime:
                 now = time.perf_counter()
                 split["arg_resolve"] = int((now - _tmark) * 1e6)
                 _tmark = now
+            # Per-task cProfile opt-in (.options(_metadata={"profile":
+            # True})): wraps ONLY the user-code call; the pstats text
+            # dumps next to the worker log so /api/logs surfaces it.
+            profiler = None
+            if spec.get("profile"):
+                import cProfile
+
+                profiler = cProfile.Profile()
             _e0 = time.perf_counter()
             if tracing_enabled() or spec.get("trace_ctx"):
                 # Execution span parents to the CALLER's span via the
@@ -3683,10 +3795,19 @@ class ClusterRuntime:
                           parent=spec.get("trace_ctx"),
                           attributes={"task_id": task_id,
                                       "component": "worker"}):
-                    value = fn(*args, **kwargs)
+                    value = (profiler.runcall(fn, *args, **kwargs)
+                             if profiler is not None
+                             else fn(*args, **kwargs))
             else:
-                value = fn(*args, **kwargs)
+                value = (profiler.runcall(fn, *args, **kwargs)
+                         if profiler is not None else fn(*args, **kwargs))
             exec_us = int((time.perf_counter() - _e0) * 1e6)
+            if profiler is not None:
+                self._dump_task_profile(profiler, task_id, name)
+            if flight.enabled:
+                flight.record("task", f"exec:{name}", dur_us=exec_us,
+                              arg=task_id[:8],
+                              t=time.monotonic() - exec_us / 1e6)
             if attr_on:
                 now = time.perf_counter()
                 split["exec"] = int((now - _tmark) * 1e6)
@@ -3946,6 +4067,8 @@ class ClusterRuntime:
             spec = from_wire_fast(merged, "TaskSpec")
             if attr_on:
                 attribution.count("ring.worker_deq")
+            if flight.enabled:
+                flight.instant("ring", "worker_deq")
         except Exception as e:  # noqa: BLE001
             # A typed ring-level failure (user exceptions ride inside
             # reply["results"]): the driver maps it onto the same

@@ -197,6 +197,27 @@ _D("lease_return_batching", True,
    "round-8 grant batch, coalesced through the same deferred-pump "
    "discipline). Disabling restores one return_worker RPC per lease.")
 
+# -- flight recorder (round 12 observability) ----------------------------
+_D("flight_recorder", True,
+   "Per-process flight recorder (core/flight.py): a fixed-capacity "
+   "ring of recent events (submit tiers, lease traffic, SPSC ring "
+   "primitives, worker exec, engine steps, GC pauses, loop-lag "
+   "heartbeats) plus the stall watchdog that snapshots the ring and "
+   "an all-threads stack dump when an event loop blocks past "
+   "stall_threshold_ms. Always-on by design (Dapper-style low-overhead "
+   "recording; the perf guard pins overhead <=10% of tasks/s); "
+   "disabling restores the zero-cost-off path at every call site.")
+_D("flight_events", 4096,
+   "Flight-recorder ring capacity (most recent N events kept).")
+_D("flight_heartbeat_ms", 50.0,
+   "Loop-lag watchdog heartbeat period: each watched event loop "
+   "schedules a beat this often and records its own scheduling delay.")
+_D("stall_threshold_ms", 100.0,
+   "A watched loop's heartbeat going overdue past this opens a stall "
+   "episode: all-threads stack dump captured mid-stall, ring snapshot "
+   "+ lag measurement written as a JSON report under the session log "
+   "dir, surfaced at GET /api/stalls.")
+
 # -- tensor plane --------------------------------------------------------
 _D("tpu_slice_gang_scheduling", True,
    "Treat a TPU slice as an atomic gang for placement-group scheduling.")

@@ -229,6 +229,11 @@ class Raylet:
         # concurrent outbound chunks).
         self._pulls = _PullManager(ray_config().object_pull_budget_bytes)
         self._inflight_pulls: Dict[str, asyncio.Future] = {}
+        # Extra flight-record sources on this node beyond spawned
+        # workers: DRIVER processes register their RPC address here so
+        # the dashboard's merged timeline/stall views cover the submit
+        # side too (pruned when a scrape finds the process gone).
+        self._flight_sources: Dict[str, float] = {}
         self._push_sem: Optional[asyncio.Semaphore] = None
         self._push_waiters = 0
 
@@ -241,6 +246,24 @@ class Raylet:
     # ------------------------------------------------------------------
     async def start(self) -> None:
         await self._rpc.start()
+        # Flight recorder (round 12): GC pauses + loop lag on the
+        # raylet's own event loop become attributable events; its
+        # dump_flight_record handler fans out to the node's workers.
+        from ray_tpu.core import flight
+
+        if not ray_config().flight_recorder:
+            flight.enabled = False
+        if flight.enabled:
+            flight.configure(
+                capacity=ray_config().flight_events,
+                stall_threshold_ms=ray_config().stall_threshold_ms,
+                heartbeat_ms=ray_config().flight_heartbeat_ms)
+            flight.set_role("raylet", node_id=self.node_id)
+            flight.install_gc_hook()
+            self._flight_watch = flight.watch_loop(
+                asyncio.get_running_loop(), name="raylet-loop")
+        else:
+            self._flight_watch = None
         await self._gcs.connect()
         await self._register_with_gcs()
         await self._gcs.subscribe("node", self._on_node_update)
@@ -263,6 +286,10 @@ class Raylet:
         # otherwise triggers _try_dispatch -> _spawn_worker, and the fresh
         # worker outlives us stuck in a connect-retry loop (orphan).
         self._stopping = True
+        if getattr(self, "_flight_watch", None) is not None:
+            from ray_tpu.core import flight
+
+            flight.unwatch_loop(self._flight_watch)
         for t in self._tasks + list(self._monitors.values()):
             t.cancel()
         for w in self._workers.values():
@@ -466,6 +493,52 @@ class Raylet:
                 "lines": chunk.decode("utf-8", "replace").splitlines(),
             })
         return out
+
+    async def handle_register_flight_source(
+            self, conn: ServerConnection, *, address: str) -> bool:
+        """A driver on this node announces its RPC address so
+        `dump_flight_record` fans out to it too — workers are known
+        from registration, but drivers otherwise never appear in the
+        merged timeline (and a driver-loop stall is exactly the kind
+        of episode the dashboard must show)."""
+        self._flight_sources[address] = time.monotonic()
+        return True
+
+    async def handle_dump_flight_record(
+            self, conn: ServerConnection, *,
+            window_s: Optional[float] = None,
+            include_events: bool = True) -> Dict[str, Any]:
+        """Node-level flight-record collection (dashboard
+        `/api/timeline` + `/api/stalls`, mirror of `get_worker_logs`):
+        this raylet's own ring plus, over the same RPC name, every
+        live worker's and registered driver's — concurrent fan-out
+        with a short per-process timeout, so one wedged process (the
+        very thing being debugged) cannot stall the endpoint for the
+        rest of the node."""
+        from ray_tpu.core import flight
+
+        records: List[Dict[str, Any]] = [
+            flight.dump(window_s=window_s,
+                        include_events=include_events)]
+
+        async def one(address: str, prune: bool = False):
+            try:
+                client = await self._worker_client(address)
+                return await client.call(
+                    "dump_flight_record", window_s=window_s,
+                    include_events=include_events, timeout=5.0)
+            except Exception:  # noqa: BLE001 — dead/wedged process
+                if prune:
+                    self._flight_sources.pop(address, None)
+                return None
+
+        targets = [one(w.address) for w in self._workers.values()
+                   if w.address and w.proc.poll() is None]
+        targets += [one(addr, prune=True)
+                    for addr in list(self._flight_sources)]
+        results = await asyncio.gather(*targets)
+        records.extend(r for r in results if isinstance(r, dict))
+        return {"node_id": self.node_id, "records": records}
 
     async def _log_monitor_loop(self) -> None:
         interval = ray_config().log_monitor_interval_s

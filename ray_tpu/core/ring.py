@@ -58,7 +58,7 @@ import time
 from multiprocessing import shared_memory
 from typing import List, Optional, Tuple
 
-from ray_tpu.core import attribution
+from ray_tpu.core import attribution, flight
 
 _HDR = struct.Struct("<QQII")          # head, tail, nslots, slot_bytes
 _LEN = struct.Struct("<I")
@@ -221,6 +221,8 @@ class RingWriter(_Ring):
             pass  # FIFO full (reader behind but awake) or reader gone
         if attribution.enabled:
             attribution.count("ring.doorbell")
+        if flight.enabled:
+            flight.instant("ring", "doorbell")
 
     def push(self, payload: bytes) -> bool:
         """Publish one entry; False when the ring is full, closed, or
@@ -239,6 +241,8 @@ class RingWriter(_Ring):
         self.tail = tail + 1
         if attribution.enabled:
             attribution.count("ring.enq")
+        if flight.enabled:
+            flight.instant("ring", "enq")
         if tail == head:
             self._doorbell()  # empty->non-empty edge only
         return True
@@ -284,6 +288,8 @@ class RingReader(_Ring):
         self.head = head + 1  # release the slot after the copy
         if attribution.enabled:
             attribution.count("ring.deq")
+        if flight.enabled:
+            flight.instant("ring", "deq")
         return payload
 
     def drain(self) -> List[bytes]:

@@ -323,6 +323,10 @@ class TaskSpec:
     pg: Optional[dict] = None          # {pg_id, bundle_index}
     visible_chips: Optional[list] = None
     trace_ctx: Optional[str] = None    # W3C traceparent (util/tracing.py)
+    # Per-task cProfile opt-in (.options(_metadata={"profile": True}):
+    # the worker wraps exec in cProfile and dumps pstats next to its
+    # log). Optional-with-default: absent on the wire when unset.
+    profile: Optional[bool] = None
 
 
 @wire_message("ActorTaskSpec", version=1)

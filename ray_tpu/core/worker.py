@@ -103,6 +103,14 @@ def init(address: Optional[str] = None, *,
                     "ignore_reinit_error=True to ignore.")
         from ray_tpu.core.config import ray_config
         ray_config().apply_system_config(_system_config)
+        if not ray_config().flight_recorder:
+            # _system_config lands only in THIS process; the recorder
+            # flag must reach raylets/workers before they spawn, and
+            # they read it from the inherited env (flight.disable sets
+            # RAY_TPU_FLIGHT_RECORDER=0 — sticky for this process's
+            # later children, like attribution's env flag).
+            from ray_tpu.core import flight
+            flight.disable()
 
         if address and address.startswith("ray://"):
             # Remote driver through the client proxy (reference:

@@ -18,6 +18,12 @@ Endpoints:
   GET /api/serve               per-deployment QPS/latency/queue state
   GET /api/train               per-trial step-time telemetry
   GET /api/logs?node=&worker=  per-worker log tails (id-prefix filters)
+  GET /api/timeline?window_s=  merged Chrome-trace JSON: every process's
+                               flight-recorder ring (task/lease/ring/gc/
+                               loop/engine events), clock-skew aligned —
+                               open in Perfetto / chrome://tracing
+  GET /api/stalls              stall episodes the loop-lag watchdogs
+                               captured (lag, report path, per-process)
   GET /metrics                 Prometheus text: all nodes + app metrics
   GET /                        tiny HTML index
 
@@ -48,6 +54,8 @@ _INDEX_HTML = """<!doctype html>
 <li><a href=/api/serve>serve deployments</a>
 <li><a href=/api/train>train telemetry</a>
 <li><a href=/api/logs>worker logs</a>
+<li><a href=/api/timeline>flight-recorder timeline (chrome trace)</a>
+<li><a href=/api/stalls>stall episodes</a>
 <li><a href=/metrics>metrics (prometheus)</a>
 </ul>
 """
@@ -163,6 +171,11 @@ class DashboardHead:
             return await self._serve_state()
         if endpoint == "train":
             return await self._train_state()
+        if endpoint == "timeline":
+            return await self._timeline(
+                window_s=float(query.get("window_s", ["60"])[0]))
+        if endpoint == "stalls":
+            return await self._stalls()
         if endpoint == "logs":
             return await self._logs(
                 node=query.get("node", [None])[0],
@@ -356,6 +369,43 @@ class DashboardHead:
             elif isinstance(r, dict):   # scrape error marker
                 merged.append(r)
         return merged
+
+    async def _timeline(self, window_s: float = 60.0) -> Dict[str, Any]:
+        """Cluster flight-recorder timeline: fan out
+        `dump_flight_record` (each raylet returns its own ring + every
+        live worker's), then merge into ONE Chrome-trace JSON — clock
+        skew aligned through each process's wall<->monotonic anchor.
+        Save the response to a file and open it in Perfetto."""
+        from ray_tpu.core import flight
+
+        results = await self._per_node("dump_flight_record",
+                                       window_s=window_s)
+        records = [rec for res in results if isinstance(res, dict)
+                   for rec in res.get("records", [])]
+        return flight.to_chrome_trace(records)
+
+    async def _stalls(self) -> list:
+        """Stall episodes captured by every process's loop-lag
+        watchdog, newest first (full forensics — ring snapshot + stack
+        dump — live in each episode's report_path file on its node)."""
+        results = await self._per_node("dump_flight_record",
+                                       include_events=False)
+        episodes = []
+        for res in results:
+            if not isinstance(res, dict):
+                continue
+            for rec in res.get("records", []):
+                for ep in rec.get("stalls") or []:
+                    # Full ring snapshots stay in the on-node report
+                    # file; the stack dump (the attribution payload)
+                    # ships — a remote node's report_path is not
+                    # otherwise reachable over HTTP.
+                    ep = dict(ep)
+                    ep.pop("events", None)
+                    ep.setdefault("node_id", res.get("node_id"))
+                    episodes.append(ep)
+        episodes.sort(key=lambda e: e.get("ts_wall", 0), reverse=True)
+        return episodes
 
     async def _metrics(self) -> str:
         from ray_tpu.util.metrics import merge_snapshots, render_prometheus

@@ -9,6 +9,12 @@ reference budgets at 50-300 µs/task (SURVEY §3.2). Run directly:
     python -m ray_tpu.perf --ring      # worker-direct dispatch rings
                                        # (tasks_ring_per_s + honesty
                                        # counters, round 10)
+    python -m ray_tpu.perf --timeline [FILE]
+                                       # flight-recorder capture: task
+                                       # burst -> merged driver+worker
+                                       # Chrome trace (round 12)
+    python -m ray_tpu.perf --flight-overhead
+                                       # recorder-on vs off tasks/s
 
 `--attribute` turns on the per-call attribution profiler
 (core/attribution.py) for the driver AND every worker it spawns, then
@@ -325,6 +331,138 @@ def run_ring_microbench(scale: float = 1.0,
     return out
 
 
+def run_timeline_capture(path: str = "ray_tpu_timeline.json",
+                         scale: float = 1.0) -> Dict[str, Any]:
+    """`python -m ray_tpu.perf --timeline`: bracket a remote task burst
+    with the (always-on) flight recorder and write the MERGED Chrome
+    trace — driver ring + every raylet's + every worker's, clock-skew
+    aligned — to `path` (open in Perfetto / chrome://tracing).
+
+    Boots its own ring-enabled cluster (inline off) so the trace shows
+    all three planes: task events (driver push_rtt + worker exec),
+    ring primitive traffic, lease churn, plus a forced gc.collect()
+    so collector pauses are visibly on the same timeline.
+    """
+    import gc
+    import os
+
+    import ray_tpu
+    from ray_tpu.core import flight
+    from ray_tpu.core.config import ray_config
+
+    ray_tpu.shutdown()
+    saved_cfg = dict(ray_config()._values)
+    ncpu = min(4, max(2, os.cpu_count() or 1))
+    ray_tpu.init(num_cpus=ncpu, _system_config={
+        "submit_ring": True, "task_inline_execution": False})
+    out: Dict[str, Any] = {}
+    try:
+        noop = ray_tpu.remote(_noop)
+        ray_tpu.get([noop.remote() for _ in range(10)], timeout=120)
+        n = max(1, int(400 * scale))
+        t0 = time.perf_counter()
+        ray_tpu.get([noop.remote() for _ in range(n)], timeout=300)
+        out["tasks_per_s"] = round(n / (time.perf_counter() - t0), 1)
+        gc.collect()  # at least one gc event inside the window
+
+        rt = ray_tpu.core.worker.current_runtime()
+        records = [flight.dump(window_s=120.0)]
+
+        async def _collect():
+            dumps = []
+            for node in await rt._gcs.get_nodes():
+                if not node.get("alive", True):
+                    continue
+                try:
+                    client = await rt._raylet_client(node["address"])
+                    dumps.append(await client.call(
+                        "dump_flight_record", window_s=120.0,
+                        timeout=10.0))
+                except Exception:  # noqa: BLE001 — skip a dead node
+                    pass
+            return dumps
+
+        for res in rt._loop.run(_collect(), timeout=30):
+            if isinstance(res, dict):
+                records.extend(res.get("records", []))
+        flight.write_chrome_trace(records, path)
+        cats: set = set()
+        roles: set = set()
+        total = 0
+        for rec in records:
+            roles.add(rec.get("role"))
+            for ev in rec.get("events", ()):
+                cats.add(ev[2])
+                total += 1
+        out.update({
+            "timeline_path": os.path.abspath(path),
+            "timeline_events": total,
+            "timeline_processes": len(records),
+            "timeline_roles": sorted(r for r in roles if r),
+            "timeline_categories": sorted(cats),
+        })
+    finally:
+        ray_tpu.shutdown()
+        ray_config()._values.clear()
+        ray_config()._values.update(saved_cfg)
+    return out
+
+
+def run_flight_overhead_bench(scale: float = 1.0,
+                              bursts: int = 4) -> Dict[str, Any]:
+    """Recorder-on vs recorder-off remote tasks/s — the "cheap when
+    on" pin for the flight recorder (guarded at <=10% delta in
+    `tests/test_perf_guards.py::test_flight_recorder_overhead`).
+
+    Two sequential clusters (the worker processes read the recorder
+    flag from their inherited env at spawn, so it cannot be toggled on
+    a live cluster), fold-best of `bursts` same-size bursts on each —
+    the same flake discipline as every other guard on a box whose
+    stall episodes swing single bursts 2-3x.
+    """
+    import os
+
+    import ray_tpu
+    from ray_tpu.core import flight
+
+    out: Dict[str, Any] = {}
+    prev_env = os.environ.get(flight.ENV_FLAG)
+    prev_enabled = flight.enabled
+    ncpu = min(4, max(2, os.cpu_count() or 1))
+    n = max(1, int(800 * scale))
+
+    def measure() -> float:
+        noop = ray_tpu.remote(_metadata={"inline": False})(_noop)
+        ray_tpu.get([noop.remote() for _ in range(10)], timeout=120)
+        best = 0.0
+        for _ in range(max(1, bursts)):
+            t0 = time.perf_counter()
+            ray_tpu.get([noop.remote() for _ in range(n)], timeout=300)
+            best = max(best, n / (time.perf_counter() - t0))
+        return round(best, 1)
+
+    try:
+        ray_tpu.shutdown()
+        flight.enable()
+        ray_tpu.init(num_cpus=ncpu, ignore_reinit_error=True)
+        out["tasks_per_s_flight_on"] = measure()
+        ray_tpu.shutdown()
+        flight.disable()
+        ray_tpu.init(num_cpus=ncpu, ignore_reinit_error=True)
+        out["tasks_per_s_flight_off"] = measure()
+    finally:
+        ray_tpu.shutdown()
+        if prev_env is None:
+            os.environ.pop(flight.ENV_FLAG, None)
+        else:
+            os.environ[flight.ENV_FLAG] = prev_env
+        flight.enabled = prev_enabled
+    out["flight_ratio"] = round(
+        out["tasks_per_s_flight_on"]
+        / max(out["tasks_per_s_flight_off"], 1e-9), 3)
+    return out
+
+
 def run_llm_serve_bench(scale: float = 1.0) -> Dict[str, Any]:
     """LLM-serving scenario: the continuous-batching engine vs the
     `@serve.batch`-style static policy on the SAME mixed-length
@@ -481,6 +619,15 @@ def main() -> None:
                         "bench (boots a ring-enabled cluster, measures "
                         "tasks_ring_per_s + the enqueue/doorbell/"
                         "fallback honesty counters)")
+    p.add_argument("--timeline", nargs="?", const="ray_tpu_timeline.json",
+                   default=None, metavar="FILE",
+                   help="bracket a task burst with the flight recorder "
+                        "and write the merged driver+raylet+worker "
+                        "Chrome-trace JSON to FILE (default "
+                        "ray_tpu_timeline.json); open in Perfetto")
+    p.add_argument("--flight-overhead", action="store_true",
+                   help="measure recorder-on vs recorder-off tasks/s "
+                        "(the <=10%% 'cheap when on' pin)")
     args = p.parse_args()
     import ray_tpu
 
@@ -489,6 +636,13 @@ def main() -> None:
         return
     if args.ring:
         print(json.dumps(run_ring_microbench(scale=args.scale)))
+        return
+    if args.timeline is not None:
+        print(json.dumps(run_timeline_capture(path=args.timeline,
+                                              scale=args.scale)))
+        return
+    if args.flight_overhead:
+        print(json.dumps(run_flight_overhead_bench(scale=args.scale)))
         return
 
     result = run_microbench(local_mode=args.local, scale=args.scale,

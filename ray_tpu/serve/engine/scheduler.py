@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ray_tpu.core import flight
 from ray_tpu.serve.engine.kv_cache import CacheOverflowError, KVCacheManager
 
 
@@ -331,7 +332,15 @@ class InferenceEngine:
         self.cache.write_range(seq.seq_id, 0, kv)
         tok = int(np.argmax(np.asarray(logits)))
         self.prefills += 1
-        self.prefill_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.prefill_s += dt
+        if flight.enabled:
+            # Engine steps in the flight ring: a decode-latency spike
+            # lines up against GC pauses / loop stalls in the merged
+            # timeline instead of being its own mystery.
+            flight.record("engine", "prefill", dur_us=int(dt * 1e6),
+                          arg=len(seq.all_tokens),
+                          t=time.monotonic() - dt)
         self._emit(seq, tok)
         if not self._maybe_finish(seq):
             with self._lock:
@@ -391,7 +400,11 @@ class InferenceEngine:
         poss = [len(s.all_tokens) - 1 for s in batch]
         logits, new_kv = self.model.decode(kvs, lasts, poss)
         logits = np.asarray(logits)
-        self.decode_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.decode_s += dt
+        if flight.enabled:
+            flight.record("engine", "decode", dur_us=int(dt * 1e6),
+                          arg=len(batch), t=time.monotonic() - dt)
         for i, seq in enumerate(batch):
             self.cache.write(seq.seq_id, poss[i], new_kv[i])
             tok = int(np.argmax(logits[i]))

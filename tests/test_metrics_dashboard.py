@@ -298,3 +298,156 @@ def test_train_step_telemetry_reaches_dashboard(ray_cluster):
     assert trial["steps"] >= 4 * 2  # 4 steps x 2 workers
     assert trial["breakdown_s"].get("step_time", 0) > 0
     assert "data_wait" in trial["breakdown_s"]
+
+
+def test_flight_timeline_endpoint(ray_cluster):
+    """`/api/timeline` merges every process's flight-recorder ring into
+    Chrome-trace JSON: well-formed on a quiet cluster, and after a task
+    burst it carries task-category events from more than one process
+    (raylet + workers), clock-aligned to non-negative timestamps."""
+    import time
+
+    import ray_tpu
+
+    base = _dashboard_url(ray_tpu)
+
+    # Quiet-cluster shape: valid Chrome trace envelope.
+    status, body = _get(base + "/api/timeline?window_s=60")
+    assert status == 200
+    trace = json.loads(body)
+    assert isinstance(trace["traceEvents"], list)
+    assert trace["displayTimeUnit"] == "ms"
+
+    @ray_tpu.remote(_metadata={"inline": False})
+    def burst_noop():
+        return 1
+
+    assert all(v == 1 for v in ray_tpu.get(
+        [burst_noop.remote() for _ in range(20)], timeout=120))
+
+    deadline = time.time() + 30
+    task_events, pids = [], set()
+    while time.time() < deadline:
+        status, body = _get(base + "/api/timeline?window_s=120")
+        assert status == 200
+        trace = json.loads(body)
+        task_events = [e for e in trace["traceEvents"]
+                       if e.get("cat") == "task"]
+        pids = {e["pid"] for e in trace["traceEvents"]
+                if e["ph"] != "M"}
+        if task_events and len(pids) >= 2:
+            break
+        time.sleep(0.5)
+    assert task_events, "no task-category events after a 20-task burst"
+    assert len(pids) >= 2, f"events span only {pids}"
+    assert any(e["name"].startswith("exec:") for e in task_events)
+    assert all(e["ts"] >= 0 for e in trace["traceEvents"]
+               if e["ph"] != "M")
+    # process_name metadata labels each merged process — including
+    # the DRIVER (registered with its raylet as a flight source), so
+    # the timeline spans the submit side too.
+    metas = [e for e in trace["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert metas and any("worker" in m["args"]["name"] for m in metas)
+    assert any("driver" in m["args"]["name"] for m in metas), metas
+
+
+def test_flight_stalls_endpoint_shape(ray_cluster):
+    """`/api/stalls` always answers with a list; episodes (when any
+    process stalled) carry the lag measurement + identity fields."""
+    import ray_tpu
+
+    base = _dashboard_url(ray_tpu)
+    status, body = _get(base + "/api/stalls")
+    assert status == 200
+    episodes = json.loads(body)
+    assert isinstance(episodes, list)
+    for ep in episodes:
+        assert "lag_ms" in ep and "loop" in ep and "pid" in ep
+
+
+def _stall_the_driver_loop():
+    import time
+
+    time.sleep(0.25)   # blocks the RPC loop: the frame the report names
+
+
+def test_induced_driver_stall_produces_report(ray_cluster):
+    """Acceptance: blocking the driver's RPC loop >150 ms produces a
+    stall episode with the loop-lag measurement, an all-threads stack
+    dump naming the blocking frame, and the surrounding ring events."""
+    import os
+    import time
+
+    import ray_tpu
+    from ray_tpu.core import flight
+
+    rt = ray_tpu.core.worker.current_runtime()
+    assert flight.enabled, "flight recorder should default on"
+    before = len(flight.stalls())
+    flight.record("task", "stall-context-marker-4242", dur_us=3)
+    rt._loop.loop.call_soon_threadsafe(_stall_the_driver_loop)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(flight.stalls()) <= before:
+        time.sleep(0.05)
+    episodes = flight.stalls()[before:]
+    assert episodes, "driver stall never produced an episode"
+    ep = episodes[-1]
+    assert ep["lag_ms"] >= 100        # 250 ms block, 100 ms threshold
+    stacks = json.dumps(ep["stacks"])
+    assert "_stall_the_driver_loop" in stacks
+    assert any(e[3] == "stall-context-marker-4242" for e in ep["events"])
+    assert ep["report_path"] and json.load(open(ep["report_path"]))
+
+    # The same episode is visible cluster-wide at /api/stalls (the
+    # driver registered itself as a flight source with its raylet).
+    base = _dashboard_url(ray_tpu)
+    deadline = time.time() + 15
+    seen = []
+    while time.time() < deadline:
+        status, body = _get(base + "/api/stalls")
+        assert status == 200
+        seen = [s for s in json.loads(body)
+                if s.get("loop") == "driver-loop"
+                and s.get("pid") == os.getpid()]
+        if seen:
+            break
+        time.sleep(0.5)
+    assert seen, "driver stall never surfaced at /api/stalls"
+    assert "_stall_the_driver_loop" in json.dumps(seen[0]["stacks"])
+
+
+def test_per_task_cprofile_optin(ray_cluster):
+    """`.options(_metadata={"profile": True})` wraps worker exec in
+    cProfile: identical results, pstats dump next to the worker log
+    (the directory `/api/logs` serves from)."""
+    import glob
+    import os
+    import time
+
+    import ray_tpu
+
+    node = ray_tpu._private_node()
+    assert node is not None
+
+    @ray_tpu.remote
+    def crunch(n):
+        return sum(i * i for i in range(n))
+
+    plain = ray_tpu.get(crunch.remote(50_000), timeout=120)
+    profiled = ray_tpu.get(
+        crunch.options(_metadata={"profile": True}).remote(50_000),
+        timeout=120)
+    assert profiled == plain
+
+    deadline = time.time() + 20
+    dumps = []
+    while time.time() < deadline:
+        dumps = glob.glob(os.path.join(
+            node.log_dir, "worker-*-profile-*.pstats.txt"))
+        if dumps:
+            break
+        time.sleep(0.25)
+    assert dumps, f"no profile dump in {node.log_dir}"
+    text = open(dumps[0]).read()
+    assert "cumulative" in text and "crunch" in text

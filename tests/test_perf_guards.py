@@ -164,3 +164,35 @@ def test_ring_direct_dispatch_floor():
         import ray_tpu
 
         ray_tpu.shutdown()
+
+
+# Round-12 flight recorder: the "cheap when on" pin. The recorder is
+# always-on by default, so this is the guard that keeps future event
+# additions honest: remote tasks/s with the recorder ON must stay
+# within 10% of recorder-OFF on the same box (fold-best of 4 bursts
+# per side inside each round; the ratio of fold-bests is what must
+# clear the floor — single bursts on this box swing 2-3x with its
+# stall episodes, which is exactly what the recorder exists to
+# attribute). Retried like every other guard: only a violation that
+# survives every round fails.
+FLIGHT_MIN_RATIO = 0.9
+
+
+def test_flight_recorder_overhead():
+    from ray_tpu.perf import run_flight_overhead_bench
+
+    best = None
+    try:
+        for _ in range(ROUNDS):
+            r = run_flight_overhead_bench(scale=0.3)
+            if best is None or r["flight_ratio"] > best["flight_ratio"]:
+                best = r
+            if best["flight_ratio"] >= FLIGHT_MIN_RATIO:
+                break
+        assert best["flight_ratio"] >= FLIGHT_MIN_RATIO, (
+            f"flight recorder overhead guard violated: {best}\n"
+            "attribute with: python -m ray_tpu.perf --flight-overhead")
+    finally:
+        import ray_tpu
+
+        ray_tpu.shutdown()

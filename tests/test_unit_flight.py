@@ -1,0 +1,195 @@
+"""Fast unit tier: the flight-recorder primitives (core/flight.py).
+
+No cluster, no sockets: the event ring (wrap-around keeps the newest N,
+category/window filtering, benign-race write path), the gc.callbacks
+source, the loop-lag watchdog firing on an artificially blocked asyncio
+loop (the stall report must name the blocking frame — captured via
+sys._current_frames() WHILE the loop is blocked), and the merged
+Chrome-trace export being valid Chrome-trace JSON.
+"""
+
+import asyncio
+import gc
+import json
+import threading
+import time
+
+import pytest
+
+from ray_tpu.core import flight
+
+pytestmark = pytest.mark.unit
+
+
+@pytest.fixture()
+def flight_state(tmp_path):
+    """Isolate + restore module state: capacity/threshold/report dir
+    back to defaults so later (cluster) modules see a clean recorder."""
+    prev_enabled = flight.enabled
+    flight.enabled = True
+    flight.configure(capacity=64, stall_threshold_ms=100.0,
+                     heartbeat_ms=20.0, report_dir=str(tmp_path))
+    flight.reset()
+    yield tmp_path
+    flight.uninstall_gc_hook()
+    flight.configure(capacity=4096, stall_threshold_ms=100.0,
+                     heartbeat_ms=50.0)
+    flight.reset()
+    flight.enabled = prev_enabled
+
+
+def test_ring_wraparound_keeps_newest(flight_state):
+    flight.configure(capacity=16)
+    for i in range(40):
+        flight.record("task", f"e{i}", dur_us=i)
+    snap = flight.snapshot()
+    assert [e[3] for e in snap] == [f"e{i}" for i in range(24, 40)]
+    assert flight.dropped() == 24
+    # Events carry (t_mono, tid, category, label, dur_us, arg) and are
+    # time-ordered.
+    ts = [e[0] for e in snap]
+    assert ts == sorted(ts)
+    assert all(e[1] == threading.get_ident() for e in snap)
+
+
+def test_category_and_window_filtering(flight_state):
+    flight.record("task", "a", dur_us=5)
+    flight.record("gc", "gen2", dur_us=100)
+    flight.record("ring", "enq")
+    assert [e[3] for e in flight.snapshot(categories={"gc"})] == ["gen2"]
+    assert {e[2] for e in flight.snapshot(
+        categories={"task", "ring"})} == {"task", "ring"}
+    # An event recorded with an old explicit start falls out of a
+    # narrow window.
+    flight.record("task", "old", t=time.monotonic() - 120.0)
+    labels = [e[3] for e in flight.snapshot(window_s=60.0)]
+    assert "old" not in labels and "a" in labels
+
+
+def test_zero_cost_off_discipline(flight_state):
+    flight.enabled = False
+    flight.record("task", "dropped")
+    assert flight.snapshot() == []
+    flight.enabled = True
+    flight.record("task", "kept")
+    assert [e[3] for e in flight.snapshot()] == ["kept"]
+
+
+def test_gc_callback_emits_events(flight_state):
+    flight.install_gc_hook()
+    try:
+        flight.reset()
+        gc.collect()
+        evs = flight.snapshot(categories={"gc"})
+        assert evs, "gc.collect() produced no flight event"
+        t, tid, cat, label, dur_us, arg = evs[-1]
+        assert label.startswith("gen")
+        assert dur_us >= 0 and isinstance(arg, int)
+    finally:
+        flight.uninstall_gc_hook()
+    # Uninstalled: collections stop recording.
+    flight.reset()
+    gc.collect()
+    assert flight.snapshot(categories={"gc"}) == []
+
+
+def _block_the_loop():
+    time.sleep(0.3)   # the blocking frame the stall report must name
+
+
+def test_watchdog_fires_on_blocked_loop(flight_state):
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    handle = flight.watch_loop(loop, "unit-loop")
+    try:
+        time.sleep(0.15)   # let the heartbeat establish a baseline
+        flight.record("task", "before-the-stall", dur_us=7)
+        loop.call_soon_threadsafe(_block_the_loop)
+        deadline = time.time() + 5
+        while time.time() < deadline and not flight.stalls():
+            time.sleep(0.02)
+        episodes = flight.stalls()
+        assert episodes, "watchdog never fired on a 300 ms block"
+        ep = episodes[-1]
+        # The loop-lag measurement (block was 300 ms, threshold 100).
+        assert ep["loop"] == "unit-loop"
+        assert 150 <= ep["lag_ms"] <= 5000
+        # The all-threads stack dump names the blocking frame —
+        # captured mid-stall from the watchdog thread.
+        stacks = json.dumps(ep["stacks"])
+        assert "_block_the_loop" in stacks
+        assert "time.sleep(0.3)" in stacks
+        # The surrounding ring events rode into the report.
+        assert any(e[3] == "before-the-stall" for e in ep["events"])
+        # Self-contained JSON report on disk.
+        assert ep["report_path"] is not None
+        with open(ep["report_path"]) as f:
+            report = json.load(f)
+        assert report["lag_ms"] == ep["lag_ms"]
+        assert "_block_the_loop" in json.dumps(report["stacks"])
+        assert report["events"]
+        # The episode itself became a ring event.
+        assert any(e[2] == "stall" for e in flight.snapshot())
+    finally:
+        flight.unwatch_loop(handle)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=5)
+        loop.close()
+
+
+def test_dump_and_chrome_trace_shape(flight_state):
+    flight.set_role("unittest", worker_id="ab" * 28, node_id="cd" * 14)
+    flight.record("task", "exec:noop", dur_us=1500, arg="t1")
+    flight.record("ring", "enq")
+    rec = flight.dump()
+    # The record is msgpack/JSON-clean and carries the clock anchor.
+    json.dumps(rec)
+    assert rec["anchor_wall"] > 0 and rec["anchor_mono"] >= 0
+    assert rec["role"] == "unittest" and rec["pid"]
+
+    # A second fake process with a SKEWED monotonic epoch: the merge
+    # must align through the anchors, not compare raw monotonics.
+    other = dict(rec, pid=rec["pid"] + 1, role="worker",
+                 anchor_mono=rec["anchor_mono"] + 1e6,
+                 events=[[e[0] + 1e6, e[1], e[2], e[3], e[4], e[5]]
+                         for e in rec["events"]])
+    trace = flight.to_chrome_trace([rec, other])
+    blob = json.dumps(trace)           # valid JSON end to end
+    parsed = json.loads(blob)
+    assert isinstance(parsed["traceEvents"], list)
+    metas = [e for e in parsed["traceEvents"] if e["ph"] == "M"]
+    assert len(metas) == 2             # one process_name per record
+    xs = [e for e in parsed["traceEvents"] if e["ph"] == "X"]
+    instants = [e for e in parsed["traceEvents"] if e["ph"] == "i"]
+    assert xs and instants
+    for e in xs + instants:
+        assert {"name", "cat", "pid", "tid", "ts"} <= e.keys()
+        assert e["ts"] >= 0
+    assert all(e["dur"] > 0 for e in xs)
+    # Clock alignment: the same event in both "processes" lands at the
+    # same wall ts despite the 1e6 s monotonic skew.
+    by_pid = {}
+    for e in xs:
+        by_pid.setdefault(e["pid"], []).append(e["ts"])
+    (a, b) = sorted(by_pid.values(), key=len)[-2:]
+    assert abs(a[0] - b[0]) < 1000  # < 1 ms apart in trace microseconds
+
+
+def test_watch_loop_replacement_and_unwatch(flight_state):
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    try:
+        flight.watch_loop(loop, "replace-me")
+        h = flight.watch_loop(loop, "replace-me")  # re-watch same name
+        flight.unwatch_loop(h)
+        # After unwatch a long block must NOT open an episode.
+        n0 = len(flight.stalls())
+        loop.call_soon_threadsafe(time.sleep, 0.25)
+        time.sleep(0.6)
+        assert len(flight.stalls()) == n0
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=5)
+        loop.close()

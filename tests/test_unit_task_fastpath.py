@@ -621,3 +621,34 @@ def test_raylet_batched_returns_recycle_and_ring_pin_retires():
         assert r.resources_available["CPU"] == 4.0
 
     _run(main())
+
+
+def test_attribution_fold_keeps_value_label_units():
+    """Regression: `_value_labels` is process-local, so a dimensionless
+    worker-side `value()` sample folded from a reply fragment used to
+    render as microseconds in the owner's snapshot. Marked fragments
+    (`attribution.value_marked`) now carry the value/duration
+    distinction across the process boundary, and `reset()` clears the
+    marker set with the stats."""
+    from ray_tpu.core import attribution
+
+    attribution.reset()
+    # A worker reply fragment: one duration (us int) + one marked
+    # dimensionless sample.
+    attribution.fold({"exec": 1500,
+                      "batch_size": attribution.value_marked(4)},
+                     prefix="worker.")
+    snap = attribution.snapshot()
+    assert snap["worker.exec"]["mean_us"] == pytest.approx(1500)
+    # The value label renders in its own units (mean/max), NOT as us.
+    assert "mean_us" not in snap["worker.batch_size"]
+    assert snap["worker.batch_size"]["mean"] == pytest.approx(4)
+    assert snap["worker.batch_size"]["max"] == pytest.approx(4)
+
+    # reset() clears the marker too: the same label recorded as a
+    # duration afterwards renders as a duration again.
+    attribution.reset()
+    attribution.record("worker.batch_size", 0.002)
+    snap = attribution.snapshot()
+    assert snap["worker.batch_size"]["mean_us"] == pytest.approx(2000)
+    attribution.reset()
