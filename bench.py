@@ -114,8 +114,11 @@ def main() -> None:
     try:
         # LLM-serving scenario (continuous-batching engine): sustained
         # tokens/s vs the static-batching baseline on the same mixed
-        # workload, TTFT, and shed-mode p99 under 2x overload — the
-        # north-star serving metrics next to the training headline.
+        # workload, TTFT, shed-mode p99 under 2x overload, and the
+        # prefix-sharing workload (warm-vs-cold tokens/s + TTFT on a
+        # shared system prompt, with prefix_hit_tokens / cow_copies
+        # honesty counters) — the north-star serving metrics next to
+        # the training headline.
         out = subprocess.run(
             [sys.executable, "-m", "ray_tpu.perf", "--llm-serve"],
             capture_output=True, text=True, timeout=300,

@@ -116,6 +116,14 @@ def engine_metrics() -> Dict[str, Any]:
             "tokens": Counter(
                 "serve_engine_tokens_generated",
                 "Tokens generated across all sequences"),
+            "prefix_hit_tokens": Counter(
+                "serve_engine_prefix_hit_tokens",
+                "Prompt tokens served from shared prefix blocks "
+                "(adopted by reference, no prefill compute)"),
+            "cow": Counter(
+                "serve_engine_cow_copies",
+                "Copy-on-write block copies (a write into a shared "
+                "KV block privatized it first)"),
             "step_phase": Counter(
                 "serve_engine_step_seconds",
                 "Cumulative model time split by phase",

@@ -39,6 +39,7 @@ Typical replica:
 from ray_tpu.serve.engine.kv_cache import (CacheOverflowError,
                                            KVCacheManager)
 from ray_tpu.serve.engine.model import TinyLM, TransformerEngineModel
+from ray_tpu.serve.engine.prefix_index import PrefixIndex
 from ray_tpu.serve.engine.scheduler import (EngineConfig,
                                             EngineOverloadedError,
                                             EngineStoppedError,
@@ -46,6 +47,6 @@ from ray_tpu.serve.engine.scheduler import (EngineConfig,
 
 __all__ = [
     "CacheOverflowError", "EngineConfig", "EngineOverloadedError",
-    "EngineStoppedError", "InferenceEngine", "KVCacheManager", "TinyLM",
-    "TokenStream", "TransformerEngineModel",
+    "EngineStoppedError", "InferenceEngine", "KVCacheManager",
+    "PrefixIndex", "TinyLM", "TokenStream", "TransformerEngineModel",
 ]

@@ -8,30 +8,47 @@ memory is committed token-by-token and freed the moment a sequence
 retires. Fragmentation is bounded to less than one block per sequence,
 and admission/preemption decisions reduce to free-block arithmetic.
 
+Blocks are **refcounted and shareable** (prefix sharing, PR 13): a full
+block holding a common prompt prefix can appear in many sequences'
+tables at once — `adopt` extends a table by reference (refcount bump,
+no copy, no recompute), `free` decrements and only reclaims a block at
+refcount zero, and a write into a block whose refcount is above one
+first copies it into a private block (**copy-on-write** — the writer
+gets its own block, every other holder keeps reading the original).
+`utilization` therefore counts *physical* blocks once no matter how
+many tables reference them. The prefix index (`prefix_index.py`) holds
+one reference on every block it indexes via `retain`/`release`, and the
+manager calls an optional *reclaimer* under block pressure so cold
+indexed prefixes are evicted instead of admissions being rejected.
+
 The manager owns two things:
 
-- **accounting**: the free-block list, per-sequence block tables and
-  written lengths — `can_allocate` / `allocate` / `free` are what the
-  iteration scheduler calls between decode steps;
+- **accounting**: the free-block list, per-block refcounts, per-sequence
+  block tables and written lengths — `can_allocate` / `allocate` /
+  `adopt` / `free` are what the iteration scheduler calls between
+  decode steps;
 - **storage**: the preallocated `[num_blocks, block_size, *kv_shape]`
   buffer itself, with `write` / `write_range` / `gather` translating
-  logical token positions through the block table. The buffer namespace
+  logical token positions through the table. The buffer namespace
   is pluggable: numpy (default — zero-copy views, exact, fast under
   `JAX_PLATFORMS=cpu`) or `jax.numpy` (device-resident cache; writes go
   through `.at[].set`, which XLA performs in place when the buffer is
   not aliased).
 
 Determinism contract (the scheduler's loop must never crash on OOM):
-`allocate` is atomic — it either extends the table to cover the request
-or changes nothing and returns False; the scheduler converts False into
-preempt-and-requeue of the lowest-priority sequence.
+`allocate` is atomic — it either extends the table (and privatizes the
+requested write range) or changes nothing and returns False; the
+scheduler converts False into preempt-and-requeue of the lowest-priority
+sequence. COW faults never surprise the decode loop: the scheduler
+passes `writable_from` so the copy is planned into the same atomic
+free-block arithmetic as table growth.
 """
 
 from __future__ import annotations
 
 import math
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -42,8 +59,9 @@ class CacheOverflowError(RuntimeError):
 
 
 class KVCacheManager:
-    """Fixed-size blocks in one preallocated buffer + per-sequence block
-    tables. Thread-safe (the engine loop and `stats()` callers race)."""
+    """Fixed-size refcounted blocks in one preallocated buffer +
+    per-sequence block tables. Thread-safe (the engine loop and
+    `stats()` callers race)."""
 
     def __init__(self, num_blocks: int, block_size: int,
                  kv_shape: Tuple[int, ...] = (), dtype=np.float32,
@@ -59,9 +77,32 @@ class KVCacheManager:
             (self.num_blocks, self.block_size) + self.kv_shape, dtype)
         # LIFO free list: recently-freed blocks are cache-warm.
         self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+        self._refs: Dict[int, int] = {}          # block -> holder count
         self._tables: Dict[str, List[int]] = {}
         self._lens: Dict[str, int] = {}
+        # Precomputed per-sequence index arrays for `gather` — rebuilt
+        # lazily after any table mutation instead of re-converting the
+        # Python list on every decode step.
+        self._table_arrays: Dict[str, np.ndarray] = {}
         self._lock = threading.Lock()
+        self.cow_copies = 0
+        self.adoptions = 0
+        # Under block pressure, `allocate` asks the reclaimer to free
+        # up to N blocks (the prefix index evicts cold nodes); the
+        # countable half feeds `can_allocate` so admission control sees
+        # evictable capacity as available instead of rejecting.
+        self._reclaimer: Optional[Callable[[int], int]] = None
+        self._evictable: Optional[Callable[[], int]] = None
+
+    def set_reclaimer(self, reclaim: Optional[Callable[[int], int]],
+                      evictable: Optional[Callable[[], int]] = None
+                      ) -> None:
+        """Install the block-pressure callbacks. `reclaim(n)` must free
+        up to n blocks (via `release`) and return how many it freed; it
+        is called WITHOUT the cache lock held. `evictable()` returns how
+        many blocks a full reclaim could free right now."""
+        self._reclaimer = reclaim
+        self._evictable = evictable
 
     # -- accounting ----------------------------------------------------
     @property
@@ -73,7 +114,9 @@ class KVCacheManager:
             return len(self._free)
 
     def utilization(self) -> float:
-        """Fraction of blocks allocated (the `cache_utilization` gauge)."""
+        """Fraction of PHYSICAL blocks allocated (the
+        `cache_utilization` gauge) — a block shared by a thousand
+        sequences counts once."""
         with self._lock:
             return 1.0 - len(self._free) / self.num_blocks
 
@@ -88,43 +131,148 @@ class KVCacheManager:
         with self._lock:
             return list(self._tables.get(seq_id, ()))
 
-    def can_allocate(self, seq_id: str, target_tokens: int) -> bool:
-        """Would `allocate(seq_id, target_tokens)` succeed right now?"""
+    def block_ref(self, block: int) -> int:
         with self._lock:
-            return self._deficit(seq_id, target_tokens) <= len(self._free)
+            return self._refs.get(block, 0)
 
-    def _deficit(self, seq_id: str, target_tokens: int) -> int:
-        have = len(self._tables.get(seq_id, ()))
+    def _plan(self, seq_id: str, target_tokens: int,
+              writable_from: Optional[int]) -> Tuple[int, int]:
+        """(growth deficit, COW copies) to cover `target_tokens` with
+        every block overlapping [writable_from, target) private."""
+        table = self._tables.get(seq_id, ())
         need = self.blocks_for(target_tokens)
-        return max(0, need - have)
+        grow = max(0, need - len(table))
+        cow = 0
+        if writable_from is not None and writable_from < target_tokens:
+            first = writable_from // self.block_size
+            for b in table[first:min(len(table), need)]:
+                if self._refs.get(b, 0) > 1:
+                    cow += 1
+        return grow, cow
 
-    def allocate(self, seq_id: str, target_tokens: int) -> bool:
-        """Grow `seq_id`'s table to cover `target_tokens` total tokens.
-        Atomic: returns False (and allocates nothing) on a shortfall.
-        Raises CacheOverflowError when the request exceeds the whole
-        cache — no amount of preemption can satisfy it."""
+    def can_allocate(self, seq_id: str, target_tokens: int,
+                     writable_from: Optional[int] = None) -> bool:
+        """Would `allocate(...)` succeed right now — counting blocks a
+        reclaim could evict as available?"""
+        with self._lock:
+            grow, cow = self._plan(seq_id, target_tokens, writable_from)
+            shortfall = grow + cow - len(self._free)
+        if shortfall <= 0:
+            return True
+        return (self._evictable is not None
+                and self._evictable() >= shortfall)
+
+    def allocate(self, seq_id: str, target_tokens: int,
+                 writable_from: Optional[int] = None) -> bool:
+        """Grow `seq_id`'s table to cover `target_tokens` total tokens;
+        when `writable_from` is given, additionally privatize (COW)
+        every shared block overlapping positions
+        [writable_from, target_tokens) so subsequent writes never fault.
+        Atomic: returns False (and changes nothing) on a shortfall,
+        after asking the reclaimer to evict cold prefixes. Raises
+        CacheOverflowError when the request exceeds the whole cache —
+        no amount of preemption can satisfy it."""
         if target_tokens > self.capacity_tokens:
             raise CacheOverflowError(
                 f"sequence needs {target_tokens} tokens; the cache holds "
                 f"{self.capacity_tokens} "
                 f"({self.num_blocks}x{self.block_size})")
-        with self._lock:
-            deficit = self._deficit(seq_id, target_tokens)
-            if deficit > len(self._free):
+        while True:
+            with self._lock:
+                grow, cow = self._plan(seq_id, target_tokens,
+                                       writable_from)
+                shortfall = grow + cow - len(self._free)
+                if shortfall <= 0:
+                    self._commit(seq_id, target_tokens, grow,
+                                 writable_from)
+                    return True
+            # Block pressure: evict cold indexed prefixes (the
+            # reclaimer calls `release`, which takes the lock — so the
+            # lock must NOT be held here) and retry; no progress means
+            # genuinely full.
+            if self._reclaimer is None:
                 return False
-            table = self._tables.setdefault(seq_id, [])
-            for _ in range(deficit):
-                table.append(self._free.pop())
+            if self._reclaimer(shortfall) <= 0:
+                return False
+
+    def _commit(self, seq_id: str, target_tokens: int, grow: int,
+                writable_from: Optional[int]) -> None:
+        table = self._tables.setdefault(seq_id, [])
+        for _ in range(grow):
+            b = self._free.pop()
+            self._refs[b] = 1
+            table.append(b)
+        if writable_from is not None and writable_from < target_tokens:
+            first = writable_from // self.block_size
+            last = min(len(table), self.blocks_for(target_tokens))
+            for i in range(first, last):
+                if self._refs.get(table[i], 0) > 1:
+                    self._privatize_locked(seq_id, i)
+        if grow:
+            self._table_arrays.pop(seq_id, None)
+
+    def adopt(self, seq_id: str, blocks: Sequence[int],
+              n_tokens: int) -> None:
+        """Extend `seq_id`'s (empty) table by REFERENCE to existing
+        blocks whose contents already cover positions [0, n_tokens) —
+        the prefix-hit admission: refcount bumps, no copy, no prefill.
+        The adopted coverage is recorded as the sequence's written
+        length, so `gather` serves it immediately."""
+        with self._lock:
+            if self._tables.get(seq_id):
+                raise ValueError(
+                    f"adopt requires an empty table for {seq_id!r}")
+            if n_tokens > len(blocks) * self.block_size:
+                raise ValueError("adopted blocks do not cover n_tokens")
+            for b in blocks:
+                if self._refs.get(b, 0) < 1:
+                    raise ValueError(f"block {b} is not allocated")
+            for b in blocks:
+                self._refs[b] += 1
+            self._tables[seq_id] = list(blocks)
+            self._lens[seq_id] = n_tokens
+            self._table_arrays.pop(seq_id, None)
+            self.adoptions += 1
+
+    def retain(self, block: int) -> None:
+        """Add one reference to an allocated block (the prefix index's
+        hold on a block it has indexed)."""
+        with self._lock:
+            if self._refs.get(block, 0) < 1:
+                raise ValueError(f"block {block} is not allocated")
+            self._refs[block] += 1
+
+    def release(self, block: int) -> bool:
+        """Drop one reference; returns True when the block went back to
+        the free list (last holder gone)."""
+        with self._lock:
+            return self._release_locked(block)
+
+    def _release_locked(self, block: int) -> bool:
+        n = self._refs.get(block, 0)
+        if n < 1:
+            raise ValueError(f"block {block} is not allocated")
+        if n == 1:
+            del self._refs[block]
+            self._free.append(block)
             return True
+        self._refs[block] = n - 1
+        return False
 
     def free(self, seq_id: str) -> int:
         """Release every block of a retired/preempted sequence; returns
-        how many blocks came back."""
+        how many blocks actually came back to the free list. Shared
+        blocks (held by the prefix index or other sequences) survive —
+        preemption only reclaims a sequence's private tail."""
         with self._lock:
             table = self._tables.pop(seq_id, [])
             self._lens.pop(seq_id, None)
-            self._free.extend(reversed(table))
-            return len(table)
+            self._table_arrays.pop(seq_id, None)
+            freed = 0
+            for b in reversed(table):
+                if self._release_locked(b):
+                    freed += 1
+            return freed
 
     # -- storage -------------------------------------------------------
     def _slot(self, seq_id: str, pos: int) -> Tuple[int, int]:
@@ -134,12 +282,45 @@ class KVCacheManager:
                 f"position {pos} of sequence {seq_id!r} has no allocated "
                 f"block (table covers "
                 f"{len(table or ()) * self.block_size} tokens)")
-        return table[pos // self.block_size], pos % self.block_size
+        return pos // self.block_size, pos % self.block_size
+
+    def _privatize_locked(self, seq_id: str, block_idx: int) -> int:
+        """The COW fault: copy a shared block into a fresh private one
+        and repoint this sequence's table at the copy. Caller holds the
+        lock and has ensured a free block exists."""
+        table = self._tables[seq_id]
+        old = table[block_idx]
+        if not self._free:
+            raise RuntimeError(
+                "COW fault with no free block — the scheduler must "
+                "allocate(writable_from=...) before writing into a "
+                "shared block")
+        new = self._free.pop()
+        if self._ns is np:
+            self._buffer[new] = self._buffer[old]
+        else:
+            self._buffer = self._buffer.at[new].set(self._buffer[old])
+        self._refs[new] = 1
+        self._refs[old] -= 1          # shared => was > 1, stays >= 1
+        table[block_idx] = new
+        self._table_arrays.pop(seq_id, None)
+        self.cow_copies += 1
+        return new
+
+    def _writable_block(self, seq_id: str, pos: int) -> Tuple[int, int]:
+        """Slot lookup that COWs on the way in (backstop — the engine
+        pre-privatizes via allocate(writable_from=...))."""
+        idx, off = self._slot(seq_id, pos)
+        table = self._tables[seq_id]
+        if self._refs.get(table[idx], 0) > 1:
+            self._privatize_locked(seq_id, idx)
+        return table[idx], off
 
     def write(self, seq_id: str, pos: int, value) -> None:
-        """Store one token's KV entry at logical position `pos`."""
+        """Store one token's KV entry at logical position `pos`. A
+        write into a shared block privatizes it first (COW)."""
         with self._lock:
-            block, off = self._slot(seq_id, pos)
+            block, off = self._writable_block(seq_id, pos)
             if self._ns is np:
                 self._buffer[block, off] = value
             else:
@@ -148,13 +329,14 @@ class KVCacheManager:
 
     def write_range(self, seq_id: str, start: int, values) -> None:
         """Store KV entries for positions [start, start+len(values)) —
-        the prefill bulk write, one block-sized slice at a time."""
+        the prefill bulk write, one block-sized slice at a time. Shared
+        blocks in the range privatize first (COW)."""
         n = len(values)
         with self._lock:
             pos = start
             written = 0
             while written < n:
-                block, off = self._slot(seq_id, pos)
+                block, off = self._writable_block(seq_id, pos)
                 take = min(self.block_size - off, n - written)
                 chunk = values[written:written + take]
                 if self._ns is np:
@@ -166,17 +348,24 @@ class KVCacheManager:
                 pos += take
             self._lens[seq_id] = max(self._lens.get(seq_id, 0), start + n)
 
+    def _table_array(self, seq_id: str) -> np.ndarray:
+        arr = self._table_arrays.get(seq_id)
+        if arr is None:
+            arr = np.asarray(self._tables.get(seq_id, ()), np.int64)
+            self._table_arrays[seq_id] = arr
+        return arr
+
     def gather(self, seq_id: str, length: Optional[int] = None):
         """Contiguous `[length, *kv_shape]` view of a sequence's cache —
-        what the model's decode step attends over. Copies only at block
-        granularity (numpy fancy-indexing over whole blocks)."""
+        what the model's decode step attends over. One fancy-indexing
+        gather over whole blocks through the precomputed per-sequence
+        index array (no per-position work)."""
         with self._lock:
-            table = self._tables.get(seq_id, [])
             n = self._lens.get(seq_id, 0) if length is None else length
             if n == 0:
                 return self._buffer[0, 0:0]
             nblocks = math.ceil(n / self.block_size)
-            idx = table[:nblocks]
+            idx = self._table_array(seq_id)[:nblocks]
             if self._ns is np:
                 out = self._buffer[idx].reshape(
                     (nblocks * self.block_size,) + self.kv_shape)
@@ -189,6 +378,7 @@ class KVCacheManager:
     def stats(self) -> Dict[str, float]:
         with self._lock:
             used = self.num_blocks - len(self._free)
+            shared = sum(1 for n in self._refs.values() if n > 1)
             return {
                 "num_blocks": self.num_blocks,
                 "block_size": self.block_size,
@@ -196,4 +386,7 @@ class KVCacheManager:
                 "free_blocks": len(self._free),
                 "utilization": used / self.num_blocks,
                 "sequences": len(self._tables),
+                "shared_blocks": shared,
+                "cow_copies": self.cow_copies,
+                "adoptions": self.adoptions,
             }

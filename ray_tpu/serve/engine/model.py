@@ -2,9 +2,16 @@
 
 The iteration scheduler drives any model through two calls:
 
-- ``prefill(tokens) -> (next_token_logits [V], kv [S, *kv_token_shape])``
-  — run the prompt once, return the logits that predict the first
-  generated token plus the per-position KV entries to cache;
+- ``prefill(tokens, prefix_kv=None) -> (next_token_logits [V],
+  kv [S-P, *kv_token_shape])`` — run the prompt once, return the logits
+  that predict the first generated token plus the per-position KV
+  entries to cache. When the engine adopted a shared prefix,
+  ``prefix_kv`` is the gathered ``[P, *kv_token_shape]`` cache of
+  positions ``[0, P)`` and the model computes (and returns) KV for the
+  unmatched tail only — prefill-from-offset, the compute half of prefix
+  sharing. Models advertise support with ``supports_prefix_prefill``;
+  without it the engine falls back to full recompute with tail-only
+  writes (capacity sharing, no compute savings);
 - ``decode(kvs, last_tokens, positions) -> (logits [B, V],
   new_kv [B, *kv_token_shape])`` — one incremental step for a batch of
   sequences: ``kvs[i]`` is sequence i's cached KV gathered from the
@@ -54,15 +61,22 @@ class TinyLM:
 
     kv_token_shape: Tuple[int, ...] = (1,)
     kv_dtype = np.float32
+    supports_prefix_prefill = True
 
     def __init__(self, vocab_size: int = 32, eos_period: int = 0,
-                 step_delay_s: float = 0.0):
+                 step_delay_s: float = 0.0,
+                 prefill_token_delay_s: float = 0.0):
         assert vocab_size >= 4
         self.vocab_size = vocab_size
         self.eos_token = 1
         self.eos_period = eos_period
         self.step_delay_s = step_delay_s
+        # Simulated per-token prefill cost: makes shared-prefill compute
+        # savings measurable in the prefix-workload bench (a prefix hit
+        # pays only the tail).
+        self.prefill_token_delay_s = prefill_token_delay_s
         self.prefill_calls = 0
+        self.prefill_tokens = 0
         self.decode_calls = 0
 
     def _next(self, cached_sum: float, last: int, pos: int) -> int:
@@ -71,12 +85,23 @@ class TinyLM:
             return self.eos_token
         return 2 + h % (self.vocab_size - 2)
 
-    def prefill(self, tokens: Sequence[int]):
+    def prefill(self, tokens: Sequence[int], prefix_kv=None):
         self.prefill_calls += 1
         toks = np.asarray(tokens, np.int64)
-        kv = toks.astype(np.float32)[:, None]          # [S, 1]
-        nxt = self._next(float(toks[:-1].sum()), int(toks[-1]),
-                         len(toks) - 1)
+        p = 0 if prefix_kv is None else int(np.asarray(prefix_kv).shape[0])
+        self.prefill_tokens += len(toks) - p
+        if self.prefill_token_delay_s:
+            import time
+
+            time.sleep(self.prefill_token_delay_s * (len(toks) - p))
+        kv = toks[p:].astype(np.float32)[:, None]      # [S-P, 1]
+        # The hash reads the CACHED prefix kv values, not the token
+        # ids — an adoption bug (wrong block, stale COW source) changes
+        # this sequence's very first token.
+        cached = float(np.asarray(prefix_kv).sum()) if p else 0.0
+        if len(toks) - 1 > p:
+            cached += float(toks[p:-1].sum())
+        nxt = self._next(cached, int(toks[-1]), len(toks) - 1)
         logits = np.full((self.vocab_size,), -1e30, np.float32)
         logits[nxt] = 0.0
         return logits, kv
@@ -127,6 +152,8 @@ class TransformerEngineModel:
     mix. MoE configs are rejected (dense engine path only).
     """
 
+    supports_prefix_prefill = True
+
     def __init__(self, params, cfg, max_batch_size: int = 8):
         import jax.numpy as jnp
 
@@ -141,8 +168,10 @@ class TransformerEngineModel:
         self.kv_dtype = np.float32
         self._max_batch = max_batch_size
         self._prefill_jit: Dict[int, object] = {}   # S_pad -> fn
+        self._prefill_cached_jit: Dict[Tuple[int, int], object] = {}
         self._decode_jit: Dict[Tuple[int, int], object] = {}
         self.prefill_calls = 0
+        self.prefill_tokens = 0
         self.decode_calls = 0
         self._jnp = jnp
 
@@ -212,6 +241,81 @@ class TransformerEngineModel:
 
         return jax.jit(run)
 
+    def _build_prefill_cached(self, t_pad: int, p_pad: int):
+        """Prefill-from-offset: tail queries attend over the adopted
+        prefix KV plus the tail's own keys — the prompt's matched head
+        is never recomputed. One jit per (tail, prefix) bucket pair."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.transformer import _rmsnorm
+        from ray_tpu.ops.rotary import apply_rotary, rotary_freqs
+
+        cfg = self._cfg
+        h, hd = cfg.n_heads, cfg.head_dim
+
+        def run(params, tail_tokens, p_len, t_len, prefix):
+            # tail_tokens [T_pad] int32 (zero-padded), p_len/t_len
+            # scalars, prefix [P_pad, L, 2, H, hd] (zero beyond p_len).
+            act = jnp.float32
+            x = params["embed"][tail_tokens].astype(act)[None]  # [1,T,D]
+            cos, sin = rotary_freqs(hd, cfg.max_seq_len, cfg.rope_theta)
+            tpos = p_len + jnp.arange(t_pad)      # absolute positions
+            tail_valid = jnp.arange(t_pad) < t_len
+            pref_valid = jnp.arange(p_pad) < p_len
+            causal_tt = ((jnp.arange(t_pad)[:, None]
+                          >= jnp.arange(t_pad)[None, :])
+                         & tail_valid[None, :])
+            prefix_l = prefix.transpose(1, 0, 2, 3, 4)  # [L,P,2,H,hd]
+
+            def layer(x, inputs):
+                lp, pkv = inputs               # pkv [P, 2, H, hd]
+                y = _rmsnorm(x, lp["ln1"])
+                qkv = jnp.einsum("bsd,dkh->kbsh", y,
+                                 lp["wqkv"].astype(act))
+                q = qkv[0].reshape(1, t_pad, h, hd)
+                k = qkv[1].reshape(1, t_pad, h, hd)
+                v = qkv[2].reshape(1, t_pad, h, hd)
+                q = apply_rotary(q, cos, sin, tpos)
+                k = apply_rotary(k, cos, sin, tpos)
+                pk = pkv[None, :, 0]           # [1, P, H, hd]
+                pv = pkv[None, :, 1]
+                scale = hd ** -0.5
+                sc_p = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q, pk,
+                    preferred_element_type=jnp.float32) * scale
+                sc_t = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q, k,
+                    preferred_element_type=jnp.float32) * scale
+                sc_p = jnp.where(pref_valid[None, None, None, :],
+                                 sc_p, -1e30)
+                sc_t = jnp.where(causal_tt[None, None], sc_t, -1e30)
+                probs = jax.nn.softmax(
+                    jnp.concatenate([sc_p, sc_t], axis=-1),
+                    axis=-1).astype(act)
+                o = (jnp.einsum("bhqk,bkhd->bqhd",
+                                probs[..., :p_pad], pv)
+                     + jnp.einsum("bhqk,bkhd->bqhd",
+                                  probs[..., p_pad:], v))
+                x = x + (o.reshape(1, t_pad, h * hd)
+                         @ lp["wo"].astype(act))
+                y = _rmsnorm(x, lp["ln2"])
+                gu = jnp.einsum("bsd,dkf->kbsf", y,
+                                lp["w13"].astype(act))
+                x = x + (jax.nn.silu(gu[0]) * gu[1]) @ lp["w2"].astype(act)
+                kv = jnp.stack([k[0], v[0]], axis=1)   # [T, 2, H, hd]
+                return x, kv
+
+            x, kvs = jax.lax.scan(layer, x, (params["layers"], prefix_l))
+            x = _rmsnorm(x, params["ln_f"])
+            last = x[0, t_len - 1]
+            logits = jnp.einsum("d,vd->v", last,
+                                params["embed"].astype(act))
+            # kvs [L, T, 2, H, hd] -> [T, L, 2, H, hd]
+            return logits, kvs.transpose(1, 0, 2, 3, 4)
+
+        return jax.jit(run)
+
     def _build_decode(self, b_pad: int, s_pad: int):
         import jax
         import jax.numpy as jnp
@@ -272,19 +376,37 @@ class TransformerEngineModel:
         return jax.jit(run)
 
     # -- engine interface ----------------------------------------------
-    def prefill(self, tokens: Sequence[int]):
+    def prefill(self, tokens: Sequence[int], prefix_kv=None):
         jnp = self._jnp
         self.prefill_calls += 1
         n = len(tokens)
-        s_pad = _next_pow2(max(n, 8))
-        fn = self._prefill_jit.get(s_pad)
+        p = 0 if prefix_kv is None else int(np.asarray(prefix_kv).shape[0])
+        self.prefill_tokens += n - p
+        if p == 0:
+            s_pad = _next_pow2(max(n, 8))
+            fn = self._prefill_jit.get(s_pad)
+            if fn is None:
+                fn = self._prefill_jit[s_pad] = self._build_prefill(s_pad)
+            padded = np.zeros((s_pad,), np.int32)
+            padded[:n] = np.asarray(tokens, np.int32)
+            logits, kv = fn(self._params, jnp.asarray(padded),
+                            jnp.int32(n))
+            return np.asarray(logits), np.asarray(kv[:n])
+        t = n - p
+        t_pad = _next_pow2(max(t, 8))
+        p_pad = _next_pow2(max(p, 8))
+        key = (t_pad, p_pad)
+        fn = self._prefill_cached_jit.get(key)
         if fn is None:
-            fn = self._prefill_jit[s_pad] = self._build_prefill(s_pad)
-        padded = np.zeros((s_pad,), np.int32)
-        padded[:n] = np.asarray(tokens, np.int32)
-        logits, kv = fn(self._params, jnp.asarray(padded),
-                        jnp.int32(n))
-        return np.asarray(logits), np.asarray(kv[:n])
+            fn = self._prefill_cached_jit[key] = \
+                self._build_prefill_cached(*key)
+        tail = np.zeros((t_pad,), np.int32)
+        tail[:t] = np.asarray(tokens[p:], np.int32)
+        cache = np.zeros((p_pad,) + self.kv_token_shape, np.float32)
+        cache[:p] = np.asarray(prefix_kv)
+        logits, kv = fn(self._params, jnp.asarray(tail), jnp.int32(p),
+                        jnp.int32(t), jnp.asarray(cache))
+        return np.asarray(logits), np.asarray(kv[:t])
 
     def decode(self, kvs: List[np.ndarray], last_tokens: Sequence[int],
                positions: Sequence[int]):

@@ -20,6 +20,18 @@ Serve replica hosts it.
 batch (the `@serve.batch` shape: form once, hold to completion) — the
 honest baseline the `llm_serve` bench compares continuous batching
 against, paying identical per-step bookkeeping.
+
+Prefix sharing (on by default): admission consults a radix prefix
+index (`prefix_index.PrefixIndex`) and ADOPTS the longest cached prefix
+by reference — matched blocks cost a refcount bump instead of prefill
+compute and duplicate cache capacity; only the unmatched tail is
+prefilled (`model.prefill(tokens, prefix_kv)` when the model supports
+prefix prefill, full recompute with tail-only writes otherwise). A
+prompt that is fully cached skips the prefill pass entirely: its first
+token is one `model.decode` step over the adopted blocks. Preemption
+frees only a sequence's private tail (shared blocks survive and stay
+indexed), and cold prefixes are LRU-evicted under block pressure
+instead of admissions being rejected.
 """
 
 from __future__ import annotations
@@ -35,6 +47,7 @@ import numpy as np
 
 from ray_tpu.core import flight
 from ray_tpu.serve.engine.kv_cache import CacheOverflowError, KVCacheManager
+from ray_tpu.serve.engine.prefix_index import PrefixIndex
 
 
 class EngineOverloadedError(RuntimeError):
@@ -50,6 +63,7 @@ class EngineConfig:
     max_new_tokens_default: int = 64
     policy: str = "continuous"     # "continuous" | "static"
     kv_array_ns: Any = None        # numpy (default) or jax.numpy
+    prefix_sharing: bool = True    # adopt cached prompt prefixes
 
 
 class TokenStream:
@@ -190,6 +204,12 @@ class InferenceEngine:
             kv_shape=kv_shape,
             dtype=getattr(model, "kv_dtype", np.float32),
             array_ns=self.config.kv_array_ns)
+        self.prefix_index: Optional[PrefixIndex] = None
+        if self.config.prefix_sharing:
+            self.prefix_index = PrefixIndex(self.cache,
+                                            self.config.block_size)
+            self.cache.set_reclaimer(self.prefix_index.evict,
+                                     self.prefix_index.evictable_blocks)
         self._waiting: deque = deque()
         self._running: List[_Sequence] = []
         self._lock = threading.Lock()
@@ -202,6 +222,7 @@ class InferenceEngine:
         self.prefills = 0
         self.preemptions = 0
         self.tokens_generated = 0
+        self.prefix_hit_tokens = 0
         self.finished = 0
         self.prefill_s = 0.0
         self.decode_s = 0.0
@@ -316,35 +337,77 @@ class InferenceEngine:
                     return
                 self._waiting.popleft()
             try:
-                self._prefill(seq)
+                if not self._prefill(seq):
+                    return   # allocation lost after the estimate: the
+                             # seq is requeued; let the batch make
+                             # progress before re-trying admission
             except Exception as e:  # noqa: BLE001
                 self.cache.free(seq.seq_id)
                 seq.stream._finish(e)
 
-    def _prefill(self, seq: _Sequence) -> None:
+    def _prefill(self, seq: _Sequence) -> bool:
         t0 = time.perf_counter()
-        ok = self.cache.allocate(seq.seq_id, len(seq.all_tokens))
-        if not ok:   # raced with another allocation: requeue
+        tokens = list(seq.all_tokens)
+        n = len(tokens)
+        hit = 0
+        if self.prefix_index is not None:
+            blocks, hit = self.prefix_index.match(tokens)
+            if hit:
+                self.cache.adopt(seq.seq_id, blocks, hit)
+        # Privatize from the first position this prefill writes: a
+        # partially-adopted shared block COWs here, planned into the
+        # same atomic free-block arithmetic as table growth.
+        ok = self.cache.allocate(seq.seq_id, n, writable_from=hit)
+        if not ok:   # lost capacity since the admission check: requeue
+            self.cache.free(seq.seq_id)
             with self._lock:
                 self._waiting.appendleft(seq)
-            return
-        logits, kv = self.model.prefill(seq.all_tokens)
-        self.cache.write_range(seq.seq_id, 0, kv)
+            return False
+        if hit == n:
+            # Full prefix hit: every prompt position is already cached.
+            # The first generated token is ONE decode step over the
+            # adopted blocks — no prefill pass at all. (The returned
+            # new_kv duplicates what the shared block already holds;
+            # writing it would force a pointless COW, so drop it.)
+            ctx = self.cache.gather(seq.seq_id, n - 1)
+            logits, _ = self.model.decode([ctx], [tokens[-1]], [n - 1])
+            logits = np.asarray(logits)[0]
+        elif hit:
+            prefix_kv = self.cache.gather(seq.seq_id, hit)
+            if getattr(self.model, "supports_prefix_prefill", False):
+                logits, tail_kv = self.model.prefill(tokens, prefix_kv)
+            else:
+                # Capacity-only sharing: the model recomputes the whole
+                # prompt, but only the unmatched tail is stored.
+                logits, kv = self.model.prefill(tokens)
+                tail_kv = kv[hit:]
+            self.cache.write_range(seq.seq_id, hit, tail_kv)
+        else:
+            logits, kv = self.model.prefill(tokens)
+            self.cache.write_range(seq.seq_id, 0, kv)
+        if self.prefix_index is not None:
+            # Seal: every full prompt block becomes adoptable.
+            self.prefix_index.insert(tokens,
+                                     self.cache.block_table(seq.seq_id))
         tok = int(np.argmax(np.asarray(logits)))
         self.prefills += 1
+        self.prefix_hit_tokens += hit
         dt = time.perf_counter() - t0
         self.prefill_s += dt
         if flight.enabled:
             # Engine steps in the flight ring: a decode-latency spike
             # lines up against GC pauses / loop stalls in the merged
-            # timeline instead of being its own mystery.
+            # timeline instead of being its own mystery; prefix_hit
+            # makes shared-prefill savings visible per admission in
+            # /api/timeline.
             flight.record("engine", "prefill", dur_us=int(dt * 1e6),
-                          arg=len(seq.all_tokens),
+                          arg=f"tokens={n} prefix_hit={hit}",
                           t=time.monotonic() - dt)
         self._emit(seq, tok)
         if not self._maybe_finish(seq):
             with self._lock:
                 self._running.append(seq)
+        return True
 
     def _ensure_capacity(self) -> None:
         """Every running sequence needs a cache slot for the token the
@@ -356,9 +419,13 @@ class InferenceEngine:
                 running = list(self._running)
             short = None
             for seq in running:
-                # Next write position = len(all_tokens) - 1 + 1 slots.
-                if not self.cache.allocate(seq.seq_id,
-                                           len(seq.all_tokens)):
+                # Next write position = len(all_tokens) - 1 + 1 slots;
+                # writable_from additionally COWs that slot's block if
+                # it is shared (a fully-adopted prompt ending mid-block
+                # faults here on its first generated token).
+                if not self.cache.allocate(
+                        seq.seq_id, len(seq.all_tokens),
+                        writable_from=len(seq.all_tokens) - 1):
                     short = seq
                     break
             if short is None:
@@ -492,6 +559,10 @@ class InferenceEngine:
         return False
 
     # -- observability -------------------------------------------------
+    @property
+    def cow_copies(self) -> int:
+        return self.cache.cow_copies
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             running = len(self._running)
@@ -502,10 +573,14 @@ class InferenceEngine:
             "prefills": self.prefills,
             "preemptions": self.preemptions,
             "tokens_generated": self.tokens_generated,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "cow_copies": self.cache.cow_copies,
             "finished": self.finished,
             "running": running,
             "waiting": waiting,
             "cache": self.cache.stats(),
+            "prefix_index": (self.prefix_index.stats()
+                             if self.prefix_index is not None else None),
             "prefill_s": round(self.prefill_s, 6),
             "decode_s": round(self.decode_s, 6),
             "ttft_p50_ms": (round(ttfts[len(ttfts) // 2] * 1e3, 3)
@@ -524,7 +599,9 @@ class InferenceEngine:
             # instruments are cumulative; the engine's own fields are
             # the source of truth for stats()).
             for attr, key in (("preemptions", "preemptions"),
-                              ("tokens_generated", "tokens")):
+                              ("tokens_generated", "tokens"),
+                              ("prefix_hit_tokens", "prefix_hit_tokens"),
+                              ("cow_copies", "cow")):
                 cur = getattr(self, attr)
                 last = self._pushed.get(attr, 0)
                 if cur > last:

@@ -79,6 +79,76 @@ def test_kv_blocks_are_reused_after_free():
         mgr.gather("b"), np.array([9.0, 8.0, 7.0, 6.0], np.float32))
 
 
+def test_kv_write_range_spans_block_boundaries():
+    """A bulk write starting mid-block and crossing several blocks
+    lands every value at its logical position (start offset != 0,
+    crossing two boundaries, ending mid-block)."""
+    mgr = KVCacheManager(num_blocks=8, block_size=4, kv_shape=(2,))
+    assert mgr.allocate("s", 11)           # 3 blocks
+    vals = np.arange(22, dtype=np.float32).reshape(11, 2)
+    mgr.write_range("s", 0, vals[:3])      # fill part of block 0
+    # Start at offset 3 of block 0, cross blocks 1 and 2, end at
+    # offset 2 of block 2.
+    mgr.write_range("s", 3, vals[3:11])
+    np.testing.assert_array_equal(mgr.gather("s"), vals)
+    assert mgr.seq_len("s") == 11
+    # A range that would run past the allocated table is rejected and
+    # everything up to the last allocated position was still written.
+    with pytest.raises(IndexError):
+        mgr.write_range("s", 10, np.zeros((4, 2), np.float32))
+
+
+def test_kv_allocate_at_exact_capacity():
+    """The == edges: one sequence taking every block succeeds; one
+    token more can never be satisfied (overflow, not False); and with
+    zero free blocks a second allocation fails atomically."""
+    mgr = KVCacheManager(num_blocks=4, block_size=4, kv_shape=())
+    assert mgr.allocate("a", 16)           # exactly the whole cache
+    assert mgr.free_blocks() == 0
+    assert mgr.allocate("a", 16)           # idempotent at the edge
+    with pytest.raises(CacheOverflowError):
+        mgr.allocate("a", 17)              # > capacity: unsatisfiable
+    assert not mgr.allocate("b", 1)        # full: atomic False
+    assert mgr.block_table("b") == []
+    mgr.free("a")
+    assert mgr.allocate("b", 16)           # exact fit after free
+    with pytest.raises(CacheOverflowError):
+        mgr.can_allocate("c", 17) or mgr.allocate("c", 17)
+
+
+def test_kv_gather_golden_equal_to_per_position_reference():
+    """The vectorized gather (precomputed per-sequence index arrays)
+    is value-identical to a naive per-position table walk, across
+    interleaved allocations, frees and partial lengths."""
+    rng = np.random.default_rng(7)
+    mgr = KVCacheManager(num_blocks=16, block_size=3, kv_shape=(2,))
+    written = {}
+    for seq, n in (("a", 7), ("b", 10), ("c", 5)):
+        assert mgr.allocate(seq, n)
+        vals = rng.standard_normal((n, 2)).astype(np.float32)
+        mgr.write_range(seq, 0, vals)
+        written[seq] = vals
+    mgr.free("b")                          # fragment the free list
+    assert mgr.allocate("d", 8)
+    vals = rng.standard_normal((8, 2)).astype(np.float32)
+    mgr.write_range("d", 0, vals)
+    written["d"] = vals
+
+    def reference(seq, length):
+        table = mgr.block_table(seq)
+        out = np.zeros((length, 2), np.float32)
+        for pos in range(length):
+            out[pos] = mgr._buffer[table[pos // mgr.block_size],
+                                   pos % mgr.block_size]
+        return out
+
+    for seq in ("a", "c", "d"):
+        n = mgr.seq_len(seq)
+        np.testing.assert_array_equal(mgr.gather(seq), reference(seq, n))
+        np.testing.assert_array_equal(mgr.gather(seq, n - 2),
+                                      reference(seq, n - 2))
+
+
 # ---------------------------------------------------------------------------
 # iteration-level scheduling
 # ---------------------------------------------------------------------------
@@ -101,7 +171,13 @@ def test_engine_matches_oracle_mixed_batch():
     for (p, n), s in zip(reqs, streams):
         assert s.tokens_so_far() == m.oracle(p, n)
         assert s.finished
-    # Everything retired: all blocks back.
+    # Everything retired: every block is either free or held ONLY by
+    # the prefix index (sealed prompt blocks stay adoptable), and
+    # releasing the index returns the cache to empty.
+    idx = eng.prefix_index
+    assert (eng.cache.free_blocks()
+            == eng.cache.num_blocks - idx.held_blocks())
+    idx.release_all()
     assert eng.cache.free_blocks() == eng.cache.num_blocks
 
 
@@ -208,6 +284,10 @@ def test_preemption_requeues_and_recovers_exactly():
     assert hi.tokens_so_far() == m.oracle([3, 5, 7], 18)
     assert lo.tokens_so_far() == m.oracle([2, 4, 6], 18)
     assert eng.preemptions > 0
+    idx = eng.prefix_index
+    assert (eng.cache.free_blocks()
+            == eng.cache.num_blocks - idx.held_blocks())
+    idx.release_all()
     assert eng.cache.free_blocks() == eng.cache.num_blocks
 
 
@@ -429,6 +509,73 @@ def test_transformer_incremental_decode_matches_full_recompute(
                 break
             seq.append(t)
         assert s.tokens_so_far() == oracle
+
+
+def test_transformer_prefill_from_offset_matches_full(tiny_transformer):
+    """Prefill-from-offset (tail attends over cached prefix KV) equals
+    the full prefill's logits and tail KV — the compute half of prefix
+    sharing on the real-model path."""
+    from ray_tpu.serve.engine import TransformerEngineModel
+
+    params, cfg = tiny_transformer
+    model = TransformerEngineModel(params, cfg)
+    prompt = [3, 17, 42, 9, 21, 5, 11, 2, 33, 40]
+    full_logits, full_kv = model.prefill(prompt)
+    for p in (4, 8, 9):
+        logits, tail_kv = model.prefill(prompt, prefix_kv=full_kv[:p])
+        np.testing.assert_allclose(logits, full_logits, atol=1e-4)
+        np.testing.assert_allclose(tail_kv, full_kv[p:], atol=1e-4)
+
+
+def test_transformer_engine_sharing_matches_no_sharing(tiny_transformer):
+    """Engine generation with prefix sharing (adoption + cached
+    prefill + COW) is token-for-token equal to the no-sharing engine
+    over the real transformer."""
+    from ray_tpu.serve.engine import (EngineConfig, InferenceEngine,
+                                      TransformerEngineModel)
+
+    params, cfg = tiny_transformer
+    base = [3, 17, 42, 9, 21, 5, 11, 2]        # seals one 8-block
+    reqs = [(base + [33], 4), (base + [40], 4), (base + [33], 4)]
+    outs = []
+    for sharing in (False, True):
+        model = TransformerEngineModel(params, cfg, max_batch_size=4)
+        eng = InferenceEngine(model, EngineConfig(
+            max_batch_size=4, block_size=8, num_blocks=16,
+            prefix_sharing=sharing))
+        streams = [eng.submit(p, n) for p, n in reqs]
+        while eng.step():
+            pass
+        outs.append([s.tokens_so_far() for s in streams])
+        if sharing:
+            assert eng.prefix_hit_tokens >= 16   # two adopters x 8
+    assert outs[0] == outs[1]
+
+
+def test_prefill_flight_event_carries_prefix_hit():
+    """Engine prefill events in the flight ring report the shared-
+    prefill savings (`prefix_hit`) so /api/timeline shows them."""
+    from ray_tpu.core import flight
+
+    prev = flight.enabled
+    flight.enable()
+    try:
+        flight.configure(256)
+        m = TinyLM()
+        eng = InferenceEngine(m, EngineConfig(block_size=4,
+                                              num_blocks=32))
+        prompt = [3, 5, 7, 9, 2, 4, 6, 8]
+        eng.submit(prompt, 3)
+        _drive(eng)
+        eng.submit(prompt, 3)                  # full prefix hit
+        _drive(eng)
+        args = [ev[5] for ev in flight.snapshot(categories={"engine"})
+                if ev[3] == "prefill"]
+        assert "tokens=8 prefix_hit=0" in args
+        assert "tokens=8 prefix_hit=8" in args
+    finally:
+        if not prev:
+            flight.disable()
 
 
 def test_transformer_shape_buckets_are_bounded(tiny_transformer):

@@ -1,0 +1,392 @@
+"""Prefix-sharing KV cache: refcounted COW blocks + radix index tier.
+
+Seconds-fast, in-process, no sockets — same discipline as
+test_unit_engine. The oracle-exactness of TinyLM (next token is a
+function of the CACHED kv values) means every sharing bug — wrong
+adopted block, stale COW source, refcount underflow reclaiming a live
+block, eviction of a pinned prefix — changes generated tokens, so the
+engine-level tests below are end-to-end correctness proofs, not just
+accounting checks.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.serve.engine import (EngineConfig, InferenceEngine,
+                                  KVCacheManager, PrefixIndex, TinyLM)
+
+pytestmark = pytest.mark.unit
+
+
+# ---------------------------------------------------------------------------
+# refcounted blocks + copy-on-write (cache tier)
+# ---------------------------------------------------------------------------
+def test_adopt_shares_blocks_and_free_respects_refcounts():
+    mgr = KVCacheManager(num_blocks=8, block_size=4, kv_shape=(1,))
+    assert mgr.allocate("a", 8)                    # 2 private blocks
+    vals = np.arange(8, dtype=np.float32).reshape(8, 1)
+    mgr.write_range("a", 0, vals)
+    table = mgr.block_table("a")
+    mgr.adopt("b", table, 8)
+    # One physical copy, two tables: utilization counts blocks once.
+    assert mgr.free_blocks() == 6
+    assert mgr.stats()["shared_blocks"] == 2
+    np.testing.assert_array_equal(mgr.gather("b"), vals)
+    # Donor retires: blocks survive (b still holds them)...
+    assert mgr.free("a") == 0
+    assert mgr.free_blocks() == 6
+    np.testing.assert_array_equal(mgr.gather("b"), vals)
+    # ...last holder retires: blocks actually reclaim.
+    assert mgr.free("b") == 2
+    assert mgr.free_blocks() == 8
+    # Adoption requires an empty table and live blocks.
+    assert mgr.allocate("c", 2)
+    with pytest.raises(ValueError):
+        mgr.adopt("c", mgr.block_table("c"), 2)
+    with pytest.raises(ValueError):
+        mgr.adopt("d", [7, 6], 8)                  # freed blocks
+
+
+def test_write_into_shared_block_copies_on_write():
+    """The COW fault: a write into a refcount>1 block lands in a fresh
+    private copy; every other holder keeps reading the original."""
+    mgr = KVCacheManager(num_blocks=8, block_size=4, kv_shape=(1,))
+    assert mgr.allocate("a", 6)
+    vals = np.arange(6, dtype=np.float32).reshape(6, 1)
+    mgr.write_range("a", 0, vals)
+    mgr.adopt("b", mgr.block_table("a"), 6)
+    mgr.write("b", 5, np.array([99.0], np.float32))
+    assert mgr.cow_copies == 1
+    # b diverged in its private copy; a is untouched.
+    expect_b = vals.copy()
+    expect_b[5] = 99.0
+    np.testing.assert_array_equal(mgr.gather("b"), expect_b)
+    np.testing.assert_array_equal(mgr.gather("a"), vals)
+    # The first block is still physically shared; the second split.
+    assert mgr.block_table("a")[0] == mgr.block_table("b")[0]
+    assert mgr.block_table("a")[1] != mgr.block_table("b")[1]
+    # Writes into the now-private copy do not copy again.
+    mgr.write("b", 4, np.array([42.0], np.float32))
+    assert mgr.cow_copies == 1
+
+
+def test_write_range_cow_across_boundary_and_accounting():
+    """A bulk write spanning a shared->shared boundary privatizes
+    exactly the blocks it touches, atomically visible to gather."""
+    mgr = KVCacheManager(num_blocks=10, block_size=4, kv_shape=())
+    assert mgr.allocate("a", 12)                   # 3 blocks
+    mgr.write_range("a", 0, np.arange(12, dtype=np.float32))
+    mgr.adopt("b", mgr.block_table("a"), 12)
+    # Overwrite positions 6..11: touches blocks 1 and 2, not block 0.
+    mgr.write_range("b", 6, np.full(6, -1.0, np.float32))
+    assert mgr.cow_copies == 2
+    np.testing.assert_array_equal(
+        mgr.gather("a"), np.arange(12, dtype=np.float32))
+    expect = np.arange(12, dtype=np.float32)
+    expect[6:] = -1.0
+    np.testing.assert_array_equal(mgr.gather("b"), expect)
+    assert mgr.block_table("a")[0] == mgr.block_table("b")[0]
+
+
+def test_allocate_writable_from_plans_cow_atomically():
+    """allocate(writable_from=...) privatizes eagerly and counts the
+    copy in the same all-or-nothing free-block arithmetic as growth."""
+    mgr = KVCacheManager(num_blocks=4, block_size=4, kv_shape=())
+    assert mgr.allocate("a", 8)                    # blocks 0,1
+    mgr.write_range("a", 0, np.arange(8, dtype=np.float32))
+    mgr.adopt("b", mgr.block_table("a"), 8)
+    # 2 free left. b wants to grow to 12 (1 new block) AND write from
+    # position 6 (COW of shared block 1): total 2 — exactly fits.
+    assert mgr.can_allocate("b", 12, writable_from=6)
+    assert mgr.allocate("b", 12, writable_from=6)
+    assert mgr.free_blocks() == 0
+    assert mgr.cow_copies == 1
+    assert mgr.block_table("b")[1] != mgr.block_table("a")[1]
+    # c adopts a's (still shared) first block; growing with a COW now
+    # needs 1 block with 0 free: atomic False, nothing changed.
+    mgr.free("b")
+    mgr.adopt("c", mgr.block_table("a"), 8)
+    assert mgr.allocate("d", 8)                    # drain the pool
+    assert mgr.free_blocks() == 0
+    before = mgr.block_table("c")
+    assert not mgr.allocate("c", 8, writable_from=7)
+    assert mgr.block_table("c") == before
+    assert mgr.cow_copies == 1
+
+
+def test_reclaimer_evicts_under_pressure():
+    """allocate under shortfall asks the reclaimer (the index's LRU
+    eviction) before giving up; can_allocate counts evictable blocks."""
+    mgr = KVCacheManager(num_blocks=4, block_size=4, kv_shape=())
+    assert mgr.allocate("a", 16)
+    table = mgr.block_table("a")
+    for b in table[:2]:
+        mgr.retain(b)                              # "indexed" cold pair
+    mgr.free("a")
+    assert mgr.free_blocks() == 2                  # 2 pinned by "index"
+    cold = list(table[:2])
+
+    def reclaim(n):
+        freed = 0
+        while cold and freed < n:
+            mgr.release(cold.pop())
+            freed += 1
+        return freed
+
+    mgr.set_reclaimer(reclaim, lambda: len(cold))
+    assert mgr.can_allocate("b", 16)               # counts evictable
+    assert mgr.allocate("b", 16)                   # evicts, then fits
+    assert mgr.free_blocks() == 0 and not cold
+
+
+# ---------------------------------------------------------------------------
+# radix prefix index
+# ---------------------------------------------------------------------------
+def _mgr_with_seq(tokens, bs=4, blocks=16):
+    mgr = KVCacheManager(num_blocks=blocks, block_size=bs, kv_shape=(1,))
+    assert mgr.allocate("seed", len(tokens))
+    mgr.write_range(
+        "seed", 0, np.asarray(tokens, np.float32).reshape(-1, 1))
+    return mgr
+
+
+def test_radix_match_full_blocks_and_partial_tail():
+    toks = list(range(10, 20))                     # 10 tokens, bs 4
+    mgr = _mgr_with_seq(toks)
+    idx = PrefixIndex(mgr)
+    idx.insert(toks, mgr.block_table("seed"))
+    assert idx.held_blocks() == 2                  # full blocks only
+    t = mgr.block_table("seed")
+    # Full-block walk.
+    assert idx.match(toks[:8]) == (t[:2], 8)
+    assert idx.match(toks[:4]) == (t[:1], 4)
+    # Sub-block remainder completing the prompt: partial-tail hit.
+    assert idx.match(toks[:6]) == (t[:2], 6)
+    # Mid-prompt divergence is NOT partially adopted.
+    blocks, covered = idx.match(toks[:5] + [0, 0, 0])
+    assert (blocks, covered) == (t[:1], 4)
+    # Diverging first block: miss.
+    assert idx.match([0] * 8) == ([], 0)
+    # Shorter-than-a-block prompt with a matching head: partial hit.
+    assert idx.match(toks[:3]) == (t[:1], 3)
+
+
+def test_radix_insert_is_idempotent_and_keeps_first_block():
+    toks = list(range(10, 18))
+    mgr = _mgr_with_seq(toks)
+    idx = PrefixIndex(mgr)
+    assert idx.insert(toks, mgr.block_table("seed")) == 2
+    held = mgr.block_table("seed")
+    # Re-inserting the same content (e.g. a raced duplicate prefill
+    # that stored its own copies) keeps the first-indexed blocks.
+    assert mgr.allocate("dup", 8)
+    mgr.write_range(
+        "dup", 0, np.asarray(toks, np.float32).reshape(-1, 1))
+    assert idx.insert(toks, mgr.block_table("dup")) == 0
+    assert idx.match(toks) == (held, 8)
+    assert idx.held_blocks() == 2
+    # The duplicate's blocks reclaim fully at free (no index pin).
+    assert mgr.free("dup") == 2
+
+
+def test_index_eviction_is_lru_leaf_only_and_skips_active():
+    bs = 4
+    mgr = KVCacheManager(num_blocks=16, block_size=bs, kv_shape=(1,))
+    idx = PrefixIndex(mgr)
+    chains = {}
+    for base in (100, 200, 300):
+        toks = [base + i for i in range(8)]        # 2-block chain each
+        mgr.allocate(str(base), 8)
+        mgr.write_range(
+            str(base), 0, np.asarray(toks, np.float32).reshape(-1, 1))
+        idx.insert(toks, mgr.block_table(str(base)))
+        chains[base] = toks
+        mgr.free(str(base))                        # index holds alone
+    # Touch chain 100 so 200 is the LRU; adopt chain 300 (active).
+    idx.match(chains[100])
+    blocks, covered = idx.match(chains[300])
+    mgr.adopt("live", blocks, covered)
+    # Evicting 2 blocks removes chain 200's leaf then its root, never
+    # an active (300) or recently-used (100) node.
+    assert idx.evict(2) == 2
+    assert idx.match(chains[200]) == ([], 0)
+    assert idx.match(chains[100])[1] == 8
+    assert idx.match(chains[300])[1] == 8
+    # A parent is never evicted before its child: chain 100's root
+    # stays while its leaf exists, and full release drains everything
+    # not actively held.
+    assert idx.evictable_blocks() == 2             # chain 100 only
+    idx.release_all()
+    assert idx.held_blocks() == 2                  # 300 pinned by live
+    mgr.free("live")
+    idx.release_all()
+    assert idx.held_blocks() == 0
+    assert mgr.free_blocks() == mgr.num_blocks
+
+
+# ---------------------------------------------------------------------------
+# engine-level sharing (oracle-exact end to end)
+# ---------------------------------------------------------------------------
+def _drive(engine, max_steps=10000):
+    steps = 0
+    while engine.step():
+        steps += 1
+        assert steps < max_steps, "engine failed to converge"
+    return steps
+
+
+def test_full_prefix_hit_skips_prefill_compute():
+    """A fully-cached prompt is admitted without any prefill pass: the
+    first token is one decode step over adopted blocks."""
+    m = TinyLM()
+    eng = InferenceEngine(m, EngineConfig(max_batch_size=4, block_size=4,
+                                          num_blocks=32))
+    prompt = [3, 5, 7, 9, 2, 4, 6, 8]              # 2 full blocks
+    s1 = eng.submit(prompt, 6)
+    _drive(eng)
+    calls, toks = m.prefill_calls, m.prefill_tokens
+    s2 = eng.submit(prompt, 6)
+    _drive(eng)
+    assert s1.tokens_so_far() == m.oracle(prompt, 6)
+    assert s2.tokens_so_far() == m.oracle(prompt, 6)
+    assert m.prefill_calls == calls                # no prefill at all
+    assert m.prefill_tokens == toks
+    assert eng.prefix_hit_tokens == 8
+    assert eng.prefills == 2                       # still an admission
+    # Block-aligned prompt: the first generated write lands in a fresh
+    # private block — no COW needed.
+    assert eng.cache.cow_copies == 0
+
+
+def test_partial_tail_prefix_hit_prefills_only_the_tail():
+    """Prompts sharing a sealed prefix prefill only their unmatched
+    tail (prefill-from-offset), oracle-exact."""
+    m = TinyLM()
+    eng = InferenceEngine(m, EngineConfig(max_batch_size=4, block_size=4,
+                                          num_blocks=64))
+    base = [3, 5, 7, 9, 2, 4, 6, 8]                # 2 full blocks
+    s1 = eng.submit(base + [11, 13], 5)
+    _drive(eng)
+    toks_before = m.prefill_tokens
+    s2 = eng.submit(base + [12, 14, 15], 5)
+    _drive(eng)
+    assert s1.tokens_so_far() == m.oracle(base + [11, 13], 5)
+    assert s2.tokens_so_far() == m.oracle(base + [12, 14, 15], 5)
+    # s2 prefilled exactly its 3-token tail.
+    assert m.prefill_tokens - toks_before == 3
+    assert eng.prefix_hit_tokens == 8
+
+
+def test_mid_block_prefix_cow_with_donor_still_decoding():
+    """A prompt that is a mid-block proper prefix of an indexed
+    sequence adopts the partial block shared; its first generated
+    write COW-faults while the donor is STILL decoding — both stay
+    oracle-exact and the donor's later reads see no corruption."""
+    m = TinyLM()
+    eng = InferenceEngine(m, EngineConfig(max_batch_size=4, block_size=4,
+                                          num_blocks=32))
+    donor = [3, 5, 7, 9, 2, 4, 6, 8, 11]           # seals blocks 0..7
+    sd = eng.submit(donor, 30)
+    for _ in range(3):
+        eng.step()
+    assert not sd.finished
+    child = donor[:6]                              # ends inside block 1
+    sc = eng.submit(child, 8)
+    _drive(eng)
+    assert sd.tokens_so_far() == m.oracle(donor, 30)
+    assert sc.tokens_so_far() == m.oracle(child, 8)
+    assert eng.prefix_hit_tokens == 6              # full hit via COW
+    assert eng.cache.cow_copies >= 1
+
+
+def test_sharing_equals_no_sharing_token_for_token():
+    """The acceptance pin: identical token streams with
+    prefix_sharing on and off, across full hits, partial tails, COW
+    faults and repeats — and both equal the oracle."""
+    reqs = [([5, 9, 3, 7], 6), ([5, 9, 3, 7], 6),
+            ([5, 9, 3, 7, 2, 2], 4), ([5, 9, 3, 7, 2, 2, 8, 8, 1], 5),
+            ([5, 9, 3], 3), ([4, 4, 4, 4, 4, 4, 4, 4], 4),
+            ([4, 4, 4, 4, 4, 4], 4)]
+    outs = []
+    for sharing in (True, False):
+        m = TinyLM()
+        eng = InferenceEngine(m, EngineConfig(
+            max_batch_size=4, block_size=4, num_blocks=64,
+            prefix_sharing=sharing))
+        streams = [eng.submit(p, n) for p, n in reqs]
+        _drive(eng)
+        outs.append([s.tokens_so_far() for s in streams])
+        for (p, n), toks in zip(reqs, outs[-1]):
+            assert toks == m.oracle(p, n)
+        if sharing:
+            assert eng.prefix_hit_tokens > 0
+    assert outs[0] == outs[1]
+
+
+def test_preemption_frees_only_private_tail_and_readopts():
+    """Under cache pressure with sharing, preemption reclaims only a
+    sequence's private tail — shared blocks survive, stay indexed, and
+    the preempted sequence re-adopts them on re-admission instead of
+    re-prefilling its prompt."""
+    m = TinyLM()
+    eng = InferenceEngine(m, EngineConfig(max_batch_size=4, block_size=4,
+                                          num_blocks=7))
+    base = [3, 5, 7, 9]                            # seals 1 shared block
+    hi = eng.submit(base + [2], 14, priority=1)
+    lo = eng.submit(base + [4], 14, priority=0)
+    _drive(eng)
+    assert hi.tokens_so_far() == m.oracle(base + [2], 14)
+    assert lo.tokens_so_far() == m.oracle(base + [4], 14)
+    assert eng.preemptions > 0
+    # The shared base block was adopted at least once (second submit
+    # or a re-admission after preemption).
+    assert eng.prefix_hit_tokens >= 4
+    idx = eng.prefix_index
+    assert (eng.cache.free_blocks()
+            == eng.cache.num_blocks - idx.held_blocks())
+    idx.release_all()
+    assert eng.cache.free_blocks() == eng.cache.num_blocks
+
+
+def test_cold_prefixes_evict_instead_of_rejecting_admission():
+    """Block pressure from a new admission LRU-evicts cold indexed
+    prefixes (instead of the engine refusing or stalling), and the
+    evicted prompt simply re-prefills on its next appearance."""
+    m = TinyLM()
+    eng = InferenceEngine(m, EngineConfig(max_batch_size=2, block_size=4,
+                                          num_blocks=8))
+    prompts = [[3 + i] * 8 for i in range(4)]      # 2 sealed blocks each
+    for p in prompts:
+        s = eng.submit(p, 4)
+        _drive(eng)
+        assert s.tokens_so_far() == m.oracle(p, 4)
+    st = eng.prefix_index.stats()
+    assert st["evictions"] > 0
+    # An evicted prefix is a plain miss afterwards: correctness holds.
+    s = eng.submit(prompts[0], 4)
+    _drive(eng)
+    assert s.tokens_so_far() == m.oracle(prompts[0], 4)
+
+
+def test_engine_stats_surface_sharing_counters():
+    eng = InferenceEngine(TinyLM(), EngineConfig(block_size=4,
+                                                 num_blocks=32))
+    prompt = [3, 5, 7, 9, 2, 4, 6, 8]
+    eng.submit(prompt, 3)
+    _drive(eng)
+    eng.submit(prompt, 3)
+    _drive(eng)
+    st = eng.stats()
+    assert st["prefix_hit_tokens"] == 8
+    assert st["cow_copies"] == 0
+    assert st["prefix_index"]["hits"] == 1
+    assert st["prefix_index"]["nodes"] == 2
+    assert st["cache"]["adoptions"] == 1
+    # Sharing off: the index is absent, counters stay zero.
+    off = InferenceEngine(TinyLM(), EngineConfig(
+        block_size=4, num_blocks=32, prefix_sharing=False))
+    off.submit(prompt, 3)
+    _drive(off)
+    assert off.prefix_index is None
+    assert off.stats()["prefix_index"] is None
+    assert off.stats()["prefix_hit_tokens"] == 0
