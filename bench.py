@@ -112,6 +112,20 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         notes["ring_bench_error"] = repr(e)
     try:
+        # Control plane at scale (round 14): lease grants/s and
+        # placement-group 2PCs/s against a real GcsServer with 100
+        # in-process simulated raylets — the cluster-property metric
+        # next to the single-box ones, isolated from fork/exec noise.
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.perf", "--simcluster",
+             "--scale", "0.5"],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        notes["simcluster"] = json.loads(
+            out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        notes["simcluster_bench_error"] = repr(e)
+    try:
         # LLM-serving scenario (continuous-batching engine): sustained
         # tokens/s vs the static-batching baseline on the same mixed
         # workload, TTFT, shed-mode p99 under 2x overload, and the

@@ -49,7 +49,7 @@ from ray_tpu.util.tracing import (current_traceparent, span,
                                   tracing_enabled)
 from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
                                 GetTimeoutError, ObjectLostError,
-                                RayActorError, RayTaskError,
+                                OwnerDiedError, RayActorError, RayTaskError,
                                 TaskCancelledError)
 
 logger = logging.getLogger(__name__)
@@ -63,6 +63,137 @@ _deser_ctx = threading.local()
 _MISS = object()
 
 INLINE_LIMIT_KEY = "max_direct_call_object_size"
+
+
+async def schedule_placement_group(gcs, raylet_client_for, pg_id: str,
+                                   info: dict, *, attempts: int = 8
+                                   ) -> str:
+    """Owner-led placement-group 2PC (reference:
+    gcs_placement_group_scheduler.h, run from the creating worker here
+    like actor placement): select nodes against the GCS view, PREPARE a
+    reservation on each, COMMIT all on success, then CAS the group
+    CREATED — rolling back every reservation of a failed attempt,
+    committed ones included, so a crash anywhere in the protocol never
+    leaks capacity.
+
+    Factored out of ClusterRuntime so `core/simcluster.py` drives the
+    IDENTICAL protocol over in-process loopback clients: the 100-node
+    fault schedules exercise this code, not a re-implementation.
+
+    `gcs` needs get_placement_group/get_nodes/update_placement_group;
+    `raylet_client_for(address)` returns an object with `.call`.
+    Returns the terminal state written ("CREATED"/"INFEASIBLE"), or
+    the observed foreign state when someone else terminated the group
+    (e.g. "REMOVED"), or "UNKNOWN" when the control plane stayed
+    unreachable past every retry."""
+    from ray_tpu.core import flight
+    from ray_tpu.core.pg_scheduler import select_pg_nodes
+
+    bundles = info["bundles"]
+    detail = "no feasible placement"
+    for attempt in range(attempts):
+        try:
+            # The user may have removed the group while we were
+            # retrying; never resurrect it.
+            current = await gcs.get_placement_group(pg_id)
+            state = (current or {}).get("state")
+            if state != "PENDING":
+                return state or "UNKNOWN"
+            nodes = [n for n in await gcs.get_nodes()
+                     if n.get("alive")]
+            placement = select_pg_nodes(bundles, nodes,
+                                        info["strategy"],
+                                        info.get("target_node_ids"))
+            if placement is None:
+                await asyncio.sleep(0.25 * (attempt + 1))
+                continue
+            prepared: List[Tuple[int, dict]] = []
+            failure = None
+            try:
+                for idx, node in enumerate(placement):
+                    client = await raylet_client_for(node["address"])
+                    r = await client.call(
+                        "prepare_bundle", pg_id=pg_id, bundle_index=idx,
+                        resources=bundles[idx], timeout=10.0)
+                    if not r.get("ok"):
+                        failure = r.get("reason", "prepare rejected")
+                        break
+                    prepared.append((idx, node))
+                if failure is None:
+                    for idx, node in prepared:
+                        client = await raylet_client_for(node["address"])
+                        await client.call("commit_bundle", pg_id=pg_id,
+                                          bundle_index=idx,
+                                          timeout=10.0)
+                    # CAS on PENDING, INSIDE the try: a CAS that raises
+                    # must reach this attempt's rollback below — an
+                    # escaped exception here once leaked every committed
+                    # bundle when a later attempt landed on different
+                    # nodes (invisible to the reconciler, which skips
+                    # CREATED groups).
+                    ok = await gcs.update_placement_group(pg_id, {
+                        "state": "CREATED",
+                        "bundle_locations": [
+                            {"node_id": n["node_id"],
+                             "address": n["address"]} for n in placement],
+                    }, expect_state="PENDING")
+                    if ok:
+                        return "CREATED"
+                    failure = "cas rejected"
+            except Exception as e:  # noqa: BLE001
+                failure = str(e)
+            # CAS miss or error: only this owner ever writes CREATED, so
+            # a CREATED read means OUR update applied (at-least-once
+            # retry whose first ack was lost) — don't roll back a live
+            # group. Any other state (REMOVED by the user, INFEASIBLE by
+            # a reconciling raylet) means roll back and let the terminal
+            # state stand.
+            try:
+                cur = await gcs.get_placement_group(pg_id)
+                if (cur or {}).get("state") == "CREATED":
+                    return "CREATED"
+            except Exception:
+                pass  # unreachable: roll back; the reconciler re-syncs
+            # Roll back EVERYTHING reserved this attempt — including
+            # already-committed bundles — or the reservation leaks
+            # (neither the reaper nor remove would ever see it). A
+            # rollback that cannot reach its raylet (node died
+            # mid-2PC) is safe to skip: the dead node's ledger died
+            # with it, and a NOT-dead-but-partitioned raylet returns
+            # the orphan itself via _maybe_reconcile_bundles.
+            detail = failure or "removed concurrently"
+            if flight.enabled:
+                flight.instant("pg", "pg.rollback",
+                               arg=f"{pg_id[:8]} n={len(prepared)}")
+            for idx, node in prepared:
+                try:
+                    client = await raylet_client_for(node["address"])
+                    await client.call("return_bundle", pg_id=pg_id,
+                                      bundle_index=idx, timeout=10.0)
+                except Exception:
+                    pass
+            await asyncio.sleep(0.25 * (attempt + 1))
+        except Exception as e:  # noqa: BLE001
+            detail = str(e)
+            await asyncio.sleep(0.25 * (attempt + 1))
+    try:
+        ok = await gcs.update_placement_group(
+            pg_id, {"state": "INFEASIBLE", "detail": detail},
+            expect_state="PENDING")
+        if ok:
+            return "INFEASIBLE"
+        # CAS miss: someone else terminated the group (user remove, a
+        # reconciling raylet) while we backed off — report the state
+        # that actually stands, not a verdict that never wrote.
+        cur = await gcs.get_placement_group(pg_id)
+        return (cur or {}).get("state") or "UNKNOWN"
+    except Exception:
+        # Control plane unreachable for the whole schedule + final
+        # verdict: raylet-side reconciliation returns any committed
+        # bundles of the still-PENDING group after pg_stuck_commit_s.
+        logger.warning("could not record INFEASIBLE for pg %s", pg_id,
+                       exc_info=True)
+        return "UNKNOWN"
 
 
 def _pg_id_of(pg: Any) -> Optional[str]:
@@ -1087,6 +1218,12 @@ class ClusterRuntime:
         if res is None:
             raise ObjectLostError(oid)
         if res.get("error"):
+            if res.get("owner_dead"):
+                # The raylet held the pull through the owner-unreachable
+                # grace window and the owner never came back: fail the
+                # borrower's get LOUDLY with the typed cause instead of
+                # a generic loss (reference: owner-died unrecoverable).
+                raise OwnerDiedError(oid)
             if "timeout" in res["error"]:
                 raise GetTimeoutError(f"timed out fetching {ref}: "
                                       f"{res['error']}")
@@ -3240,79 +3377,10 @@ class ClusterRuntime:
         return pg_id
 
     async def _schedule_pg_async(self, pg_id: str, info: dict) -> None:
-
-        from ray_tpu.core.pg_scheduler import select_pg_nodes
-
-        bundles = info["bundles"]
-        detail = "no feasible placement"
-        for attempt in range(8):
-            try:
-                # The user may have removed the group while we were
-                # retrying; never resurrect it.
-                current = await self._gcs.get_placement_group(pg_id)
-                if (current or {}).get("state") != "PENDING":
-                    return
-                nodes = [n for n in await self._gcs.get_nodes()
-                         if n.get("alive")]
-                placement = select_pg_nodes(bundles, nodes,
-                                            info["strategy"],
-                                            info.get("target_node_ids"))
-                if placement is None:
-                    await asyncio.sleep(0.25 * (attempt + 1))
-                    continue
-                prepared: List[Tuple[int, dict]] = []
-                failure = None
-                try:
-                    for idx, node in enumerate(placement):
-                        client = await self._raylet_client(node["address"])
-                        r = await client.call(
-                            "prepare_bundle", pg_id=pg_id, bundle_index=idx,
-                            resources=bundles[idx], timeout=10.0)
-                        if not r.get("ok"):
-                            failure = r.get("reason", "prepare rejected")
-                            break
-                        prepared.append((idx, node))
-                    committed_all = False
-                    if failure is None:
-                        for idx, node in prepared:
-                            client = await self._raylet_client(
-                                node["address"])
-                            await client.call("commit_bundle", pg_id=pg_id,
-                                              bundle_index=idx,
-                                              timeout=10.0)
-                        committed_all = True
-                except Exception as e:  # noqa: BLE001
-                    failure = str(e)
-                    committed_all = False
-                if failure is None and committed_all:
-                    # CAS on PENDING: if a concurrent remove won, roll the
-                    # committed bundles back, don't resurrect the PG.
-                    ok = await self._gcs.update_placement_group(pg_id, {
-                        "state": "CREATED",
-                        "bundle_locations": [
-                            {"node_id": n["node_id"],
-                             "address": n["address"]} for n in placement],
-                    }, expect_state="PENDING")
-                    if ok:
-                        return
-                # Roll back EVERYTHING reserved this attempt — including
-                # already-committed bundles — or the reservation leaks
-                # (neither the reaper nor remove would ever see it).
-                detail = failure or "removed concurrently"
-                for idx, node in prepared:
-                    try:
-                        client = await self._raylet_client(node["address"])
-                        await client.call("return_bundle", pg_id=pg_id,
-                                          bundle_index=idx, timeout=10.0)
-                    except Exception:
-                        pass
-                await asyncio.sleep(0.25 * (attempt + 1))
-            except Exception as e:  # noqa: BLE001
-                detail = str(e)
-                await asyncio.sleep(0.25 * (attempt + 1))
-        await self._gcs.update_placement_group(
-            pg_id, {"state": "INFEASIBLE", "detail": detail},
-            expect_state="PENDING")
+        # The 2PC itself is the module-level schedule_placement_group —
+        # one protocol definition shared with the simcluster harness.
+        await schedule_placement_group(self._gcs, self._raylet_client,
+                                       pg_id, info)
 
     def placement_group_wait(self, pg_id: str,
                              timeout: Optional[float] = None) -> bool:
@@ -3334,6 +3402,14 @@ class ClusterRuntime:
             return
 
         async def _remove():
+            # Record REMOVED FIRST, then return the bundles: any return
+            # that fails (dead raylet, dropped message, owner crash
+            # mid-loop) is mopped up by raylet-side reconciliation
+            # against the terminal state (_maybe_reconcile_bundles).
+            # The reverse order strands committed bundles behind a
+            # forever-CREATED record nobody will ever reclaim.
+            await self._gcs.update_placement_group(
+                pg_id, {"state": "REMOVED"})
             for idx, loc in enumerate(info.get("bundle_locations") or []):
                 try:
                     client = await self._raylet_client(loc["address"])
@@ -3341,8 +3417,6 @@ class ClusterRuntime:
                                       bundle_index=idx, timeout=10.0)
                 except Exception:
                     pass
-            await self._gcs.update_placement_group(
-                pg_id, {"state": "REMOVED"})
 
         self._loop.run(_remove(), timeout=30)
         self._pg_cache.pop(pg_id, None)

@@ -95,6 +95,37 @@ _D("health_check_period_ms", 1000,
 _D("health_check_failure_threshold", 5,
    "Missed health checks before a node is marked dead.")
 _D("gcs_rpc_timeout_s", 30.0, "Client-side timeout for GCS RPCs.")
+_D("gcs_reconnect_backoff_base_ms", 50.0,
+   "First retry delay of the GCS-reconnect backoff. Retries grow "
+   "exponentially from here with FULL jitter (each sleep is uniform in "
+   "[0, min(cap, base*2^attempt)]) so 100 clients losing the GCS at once "
+   "de-synchronize instead of hammering the restarted server in "
+   "lockstep (the classic thundering-herd fix; reference: gcs_client "
+   "reconnect backoff).")
+_D("gcs_reconnect_backoff_max_ms", 5000.0,
+   "Cap on the GCS-reconnect backoff delay.")
+_D("gcs_restart_node_grace_ms", 0,
+   "After a GCS restart recovers persisted node records, a recovered "
+   "node is not declared dead until this grace has passed without a "
+   "heartbeat — every raylet needs at least one full heartbeat interval "
+   "to find the restarted server before the health loop may judge it. "
+   "0 = derive from health_check_period_ms * health_check_failure_"
+   "threshold.")
+_D("owner_unreachable_grace_s", 5.0,
+   "How long a borrower-side pull tolerates an unreachable object owner "
+   "before declaring the owner dead: within the grace the pull retries "
+   "(transient blip, GCS failover), past it the get fails loudly with "
+   "OwnerDiedError instead of hanging or mislabeling the loss "
+   "(reference: OBJECT_UNRECOVERABLE_OWNER_DIED).")
+_D("pg_reconcile_interval_s", 5.0,
+   "How often a raylet reconciles its committed placement-group bundles "
+   "against the GCS table, returning reservations whose group is "
+   "REMOVED/INFEASIBLE/lost — the backstop that stops a mid-2PC crash "
+   "(owner or GCS) from leaking capacity cluster-wide.")
+_D("pg_stuck_commit_s", 60.0,
+   "A committed bundle whose placement group never reached CREATED "
+   "within this window is returned by the reconciler (owner died "
+   "between commit and the CREATED CAS).")
 _D("raylet_heartbeat_period_ms", 250, "Raylet->GCS resource report interval.")
 _D("actor_restart_backoff_ms", 1000, "Backoff between actor restarts.")
 _D("metrics_report_interval_ms", 2000, "Metrics agent scrape/export interval.")

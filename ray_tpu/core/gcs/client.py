@@ -15,6 +15,31 @@ from ray_tpu.core.rpc import ConnectionLost, RpcClient
 logger = logging.getLogger(__name__)
 
 
+def backoff_delay(attempt: int, rng=None, *,
+                  base_s: Optional[float] = None,
+                  cap_s: Optional[float] = None) -> float:
+    """Capped exponential backoff with FULL jitter (AWS-style:
+    sleep = uniform(0, min(cap, base * 2^attempt))).
+
+    One definition shared by every control-plane retry loop — the real
+    `_ReconnectingRpc._reconnect` below and `core/simcluster.py`'s
+    simulated clients — so the de-synchronization property the scale
+    harness measures is the property production runs. A fixed sleep here
+    (the pre-round-14 0.5 s) synchronizes 100 reconnecting clients into
+    a thundering herd against a just-restarted GCS."""
+    import random
+
+    from ray_tpu.core.config import ray_config
+
+    cfg = ray_config()
+    base = (cfg.gcs_reconnect_backoff_base_ms / 1000.0
+            if base_s is None else base_s)
+    cap = (cfg.gcs_reconnect_backoff_max_ms / 1000.0
+           if cap_s is None else cap_s)
+    ceiling = min(cap, base * (2 ** min(attempt, 32)))
+    return (rng or random).uniform(0.0, ceiling)
+
+
 class _ReconnectingRpc:
     """RpcClient facade that survives a GCS restart (reference: GCS
     fault tolerance — workers/raylets reconnect against the restarted
@@ -69,6 +94,7 @@ class _ReconnectingRpc:
             return await self._client.call(method, **kwargs)
 
     async def _reconnect(self) -> None:
+        from ray_tpu.core import flight
         from ray_tpu.core.config import ray_config
 
         async with self._reconnect_lock:
@@ -78,9 +104,12 @@ class _ReconnectingRpc:
             window = ray_config().gcs_rpc_timeout_s
             deadline = loop.time() + window
             last_err: Optional[Exception] = None
+            attempt = 0
             while loop.time() < deadline:
                 fresh = RpcClient(self.address)
                 try:
+                    if flight.enabled:
+                        flight.instant("gcs", "gcs.retry", arg=attempt)
                     await fresh.connect(
                         timeout=min(5.0, max(0.5,
                                              deadline - loop.time())))
@@ -102,7 +131,10 @@ class _ReconnectingRpc:
                         pass
                     for ch in self._subscribed:
                         await fresh.call("subscribe", channel=ch)
-                    logger.info("reconnected to GCS at %s", self.address)
+                    logger.info("reconnected to GCS at %s (attempt %d)",
+                                self.address, attempt)
+                    if flight.enabled:
+                        flight.instant("gcs", "gcs.reconnect", arg=attempt)
                     return
                 except Exception as e:  # noqa: BLE001
                     last_err = e
@@ -110,15 +142,24 @@ class _ReconnectingRpc:
                         await fresh.close()
                     except Exception:
                         pass
-                    await asyncio.sleep(0.5)
+                    # Capped exponential backoff with full jitter: a herd
+                    # of clients that lost the GCS together must not
+                    # retry in lockstep (satellite of ISSUE 14; fixed
+                    # 0.5 s before).
+                    await asyncio.sleep(backoff_delay(attempt))
+                    attempt += 1
             raise ConnectionLost(
-                f"GCS at {self.address} unreachable for {window}s: "
-                f"{last_err}")
+                f"GCS at {self.address} unreachable for {window}s "
+                f"({attempt} attempts): {last_err}")
 
 
 class GcsClient:
-    def __init__(self, address: str):
-        self.rpc = _ReconnectingRpc(address)
+    def __init__(self, address: str, rpc: Optional[Any] = None):
+        # `rpc` is injectable so core/simcluster.py can bind the SAME
+        # typed accessors to an in-process loopback channel: the sim's
+        # raylets speak to the real GcsServer through the real client
+        # code, minus the TCP socket.
+        self.rpc = rpc if rpc is not None else _ReconnectingRpc(address)
 
     async def connect(self, timeout: float = 10.0) -> None:
         await self.rpc.connect(timeout=timeout)

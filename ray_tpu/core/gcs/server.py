@@ -59,12 +59,23 @@ class GcsServer:
         self._heartbeats: Dict[str, float] = {}
         self._health_task: Optional[asyncio.Task] = None
         self._start_time = time.time()
+        # Post-restart grace: until this instant, nodes recovered from
+        # persisted state (stale_view=True) are exempt from health-check
+        # death — they need at least one full heartbeat interval to find
+        # the restarted server before we may judge them (set by
+        # _load_storage when it recovers alive nodes).
+        self._restart_grace_until = 0.0
 
     @property
     def address(self) -> str:
         return self._rpc.address
 
-    async def start(self) -> None:
+    async def start(self, serve_rpc: bool = True) -> None:
+        """`serve_rpc=False` runs the full control plane — storage
+        recovery, health loop, snapshot loop, every handler — without
+        binding a TCP listener. core/simcluster.py uses it to drive N
+        simulated raylets against this REAL server through in-process
+        loopback dispatch."""
         self._load_storage()
         # Cluster identity: ephemeral ports get reused across test
         # clusters on one box, and a reconnecting client could silently
@@ -82,12 +93,14 @@ class GcsServer:
         else:
             self.cluster_id = (cid.decode() if isinstance(cid, bytes)
                                else str(cid))
-        await self._rpc.start()
+        if serve_rpc:
+            await self._rpc.start()
         self._health_task = asyncio.ensure_future(self._health_loop())
         if self._storage_path:
             self._snapshot_task = asyncio.ensure_future(
                 self._snapshot_loop())
-        logger.info("GCS listening on %s", self.address)
+        if serve_rpc:
+            logger.info("GCS listening on %s", self.address)
 
     async def handle_cluster_id(self, conn: ServerConnection) -> str:
         return self.cluster_id
@@ -98,7 +111,14 @@ class GcsServer:
     # log; a full snapshot is written only when the WAL grows past
     # `gcs_wal_compact_bytes` (compaction), so flush cost is O(delta), not
     # O(cluster state). --------------------------------------------------
-    _PERSISTED_TABLES = ("actors", "named_actors", "jobs",
+    # Nodes persist too (round 14): at 100 nodes, losing the membership
+    # table on every GCS restart forced a full re-registration storm
+    # before any scheduling could resume. Recovered records come back
+    # with stale_view=True (resource view unconfirmed) and enjoy a
+    # health-check grace window; a node's first post-restart heartbeat
+    # reconciles the live view and clears the flag — no re-register RPC
+    # needed, no herd.
+    _PERSISTED_TABLES = ("nodes", "actors", "named_actors", "jobs",
                          "placement_groups", "kv")
 
     def mark_dirty(self, table: Optional[str] = None,
@@ -247,10 +267,33 @@ class GcsServer:
             if replayed:
                 logger.info("GCS replayed %d WAL batches", replayed)
         # Recovered actor records point at pre-restart workers; their
-        # liveness is re-established by owners / health checks. Nodes are
-        # NOT persisted — raylets re-register via heartbeat.
-        logger.info("GCS recovered %d actors, %d jobs, %d kv keys from %s",
+        # liveness is re-established by owners / health checks. Recovered
+        # NODE records carry a pre-crash resource view: mark them stale
+        # (cleared by their first live heartbeat; pg_scheduler deprefers
+        # stale views) and open the post-restart grace window so the
+        # health loop cannot storm _mark_node_dead before the raylets
+        # have had one full heartbeat interval to find us.
+        recovered_alive = [n for n in self.nodes.values()
+                           if n.get("alive")]
+        if recovered_alive:
+            cfg = ray_config()
+            grace_ms = cfg.gcs_restart_node_grace_ms or (
+                cfg.health_check_period_ms
+                * cfg.health_check_failure_threshold)
+            now = time.time()
+            self._restart_grace_until = now + grace_ms / 1000.0
+            for info in recovered_alive:
+                info["stale_view"] = True
+                # Seed the heartbeat clock at boot: a recovered node that
+                # never reports again ages out of the grace window into a
+                # normal missed-heartbeat death instead of living forever
+                # on a missing dict entry.
+                self._heartbeats.setdefault(info["node_id"], now)
+        logger.info("GCS recovered %d actors, %d jobs, %d kv keys, "
+                    "%d nodes (%d alive, grace %.1fs) from %s",
                     len(self.actors), len(self.jobs), len(self.kv),
+                    len(self.nodes), len(recovered_alive),
+                    max(0.0, self._restart_grace_until - time.time()),
                     self._storage_path)
 
     async def _snapshot_loop(self) -> None:
@@ -315,6 +358,11 @@ class GcsServer:
     def _append_wal(self, frame: bytes) -> None:
         import os
 
+        if not self._storage_path:
+            # Storage severed under us (simcluster kill -9: a flush
+            # already past flush_now's entry check must fail, not land
+            # in a stray file): surface as a failed write.
+            raise OSError("GCS storage detached")
         with open(self._wal_path(), "ab") as f:
             f.write(frame)
             f.flush()
@@ -324,6 +372,9 @@ class GcsServer:
     def _write_snapshot_and_truncate(self, blob: bytes) -> None:
         import os
         import threading
+
+        if not self._storage_path:
+            raise OSError("GCS storage detached")
 
         # Unique tmp per writer: stop()'s final flush may overlap an
         # in-flight to_thread write; each renames atomically.
@@ -366,6 +417,14 @@ class GcsServer:
             for node_id, info in list(self.nodes.items()):
                 if not info.get("alive"):
                     continue
+                if (info.get("stale_view")
+                        and now < self._restart_grace_until):
+                    # Post-restart grace: this node was recovered from
+                    # storage and has not re-confirmed yet — give it a
+                    # full re-registration window before any death
+                    # verdict (a restart must not read as 100
+                    # simultaneous node failures).
+                    continue
                 last = self._heartbeats.get(node_id, now)
                 if now - last > period * threshold:
                     logger.warning("node %s missed heartbeats; marking dead",
@@ -378,6 +437,11 @@ class GcsServer:
             return
         info["alive"] = False
         info["end_time"] = time.time()
+        self.mark_dirty("nodes", node_id)
+        from ray_tpu.core import flight
+
+        if flight.enabled:
+            flight.instant("node", "node.dead", arg=node_id[:8])
         await self._publish("node", {
             "node_id": node_id, "alive": False,
             "address": (self.nodes.get(node_id) or {}).get("address")})
@@ -471,6 +535,7 @@ class GcsServer:
         }
         self._heartbeats[node_id] = time.time()
         conn.metadata["node_id"] = node_id
+        self.mark_dirty("nodes", node_id)
         await self._publish("node", {"node_id": node_id, "alive": True})
         return {"ok": True, "was_dead": was_dead}
 
@@ -479,13 +544,20 @@ class GcsServer:
                                load: Optional[Dict[str, Any]] = None) -> bool:
         info = self.nodes.get(node_id)
         if info is None or not info.get("alive", False):
-            # Unknown (GCS restarted; nodes are not persisted) or
+            # Unknown (registration lost with an unpersisted crash) or
             # previously declared dead: the raylet must re-register
             # before its heartbeats count (GCS FT re-registration
             # contract — raylet re-registers on a False reply).
             return False
         self._heartbeats[node_id] = time.time()
         info["resources_available"] = resources_available
+        # First heartbeat after a restart reconciles the recovered
+        # record: the live view replaces the persisted snapshot.
+        info.pop("stale_view", None)
+        # Bind the node to this connection so a post-restart disconnect
+        # still marks it dead promptly — recovered nodes never re-call
+        # register_node, which is where the binding used to happen.
+        conn.metadata["node_id"] = node_id
         if load is not None:
             info["load"] = load
         return True
@@ -681,6 +753,12 @@ class GcsServer:
             info: Dict[str, Any]) -> bool:
         self.placement_groups[pg_id] = dict(info, pg_id=pg_id)
         self.mark_dirty("placement_groups", pg_id)
+        # Write-through: the registered record is what raylet-side
+        # bundle reconciliation trusts after a crash — a PG whose
+        # registration died with the debounce would read as "lost" and
+        # have its half-prepared bundles returned while the owner still
+        # believes it is scheduling (2PC atomicity, ISSUE 14).
+        await self.flush_now()
         return True
 
     async def handle_update_placement_group(
@@ -697,6 +775,13 @@ class GcsServer:
         info.update(updates)
         self.mark_dirty("placement_groups", pg_id)
         await self._publish(f"pg:{pg_id}", info)
+        if updates.get("state") in ("CREATED", "REMOVED", "INFEASIBLE"):
+            # Terminal transitions are registration-class (see
+            # flush_now docstring): an acked CREATED that a kill -9
+            # forgets would leave committed bundles pointing at a
+            # PENDING ghost after restart — exactly the half-reserved
+            # state the chaos test forbids.
+            await self.flush_now()
         return True
 
     async def handle_get_placement_group(

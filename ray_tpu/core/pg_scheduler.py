@@ -56,11 +56,19 @@ def select_pg_nodes(bundles: List[Dict[str, float]],
         return out
 
     # Most-available-first ordering (scorer.h tie-break: spread load).
+    # Nodes whose resource view is STALE — recovered from persisted GCS
+    # state after a restart, not yet re-confirmed by a heartbeat — sort
+    # behind every fresh node: their recorded availability may describe
+    # a pre-crash world, and a prepare against it fails and burns a 2PC
+    # round trip.
     def capacity(nid: str) -> float:
         a = avail[nid]
         return a.get("CPU", 0.0) + a.get("TPU", 0.0)
 
-    ordered = sorted(avail, key=capacity, reverse=True)
+    def freshness_then_capacity(nid: str):
+        return (0 if by_id[nid].get("stale_view") else 1, capacity(nid))
+
+    ordered = sorted(avail, key=freshness_then_capacity, reverse=True)
 
     if strategy == "STRICT_PACK":
         total: Dict[str, float] = {}
@@ -109,7 +117,7 @@ def select_pg_nodes(bundles: List[Dict[str, float]],
             # Best-effort spread: most-available feasible node that isn't
             # the one we just used, falling back to any feasible node.
             candidates = sorted((n for n in avail if _fits(avail[n], demand)),
-                                key=capacity, reverse=True)
+                                key=freshness_then_capacity, reverse=True)
             if not candidates:
                 return None
             nid = next((n for n in candidates if n != last), candidates[0])

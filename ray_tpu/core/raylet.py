@@ -65,11 +65,217 @@ class _Bundle:
         self.committed = False
         self.removed = False
         self.prepared_at = time.monotonic()
+        self.committed_at = 0.0  # set by handle_commit_bundle
 
     def in_use(self) -> Dict[str, float]:
         return {k: self.total[k] - self.available.get(k, 0.0)
                 for k in self.total
                 if self.total[k] - self.available.get(k, 0.0) > 1e-9}
+
+
+class NodeLedger:
+    """Per-node resource accounting + placement-group 2PC + the
+    spillback policy — the scheduling brain of a raylet, factored out of
+    the process machinery (workers, object store, sockets) so
+    `core/simcluster.py` can run a hundred of these in one process
+    against a real GcsServer and exercise the REAL paths a 100-node
+    failure hits.
+
+    Consumers provide: `node_id`, `resources_total`,
+    `resources_available`, `_bundles` ({key: _Bundle}), `_chips_free`
+    (list of free TPU chip ids), `_cluster_view` ({node_id: node info}),
+    and `_gcs` (a GcsClient) for bundle reconciliation."""
+
+    # throttles _maybe_reconcile_bundles; instance attr once it runs
+    _last_bundle_reconcile = 0.0
+
+    def _fits(self, avail: Dict[str, float],
+              demand: Dict[str, float]) -> bool:
+        return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+
+    def _acquire(self, demand: Dict[str, float]) -> None:
+        for k, v in demand.items():
+            self.resources_available[k] = self.resources_available.get(
+                k, 0.0) - v
+
+    def _release(self, demand: Dict[str, float]) -> None:
+        for k, v in demand.items():
+            self.resources_available[k] = min(
+                self.resources_available.get(k, 0.0) + v,
+                self.resources_total.get(k, v))
+
+    def _pick_spillback(self, demand: Dict[str, float]) -> Optional[str]:
+        """Best remote node that can host the demand now (spread by most
+        available, the scorer's tie-break in the reference)."""
+        best, best_score = None, -1.0
+        for node_id, info in self._cluster_view.items():
+            if node_id == self.node_id or not info.get("alive"):
+                continue
+            avail = info.get("resources_available", {})
+            if not self._fits(avail, demand):
+                continue
+            score = sum(avail.get(k, 0.0) for k in ("CPU", "TPU"))
+            if score > best_score:
+                best, best_score = info["address"], score
+        return best
+
+    def _feasible_locally(self, demand: Dict[str, float]) -> bool:
+        return self._fits(self.resources_total, demand)
+
+    def _maybe_spillback(self, demand: Dict[str, float],
+                         spillback_count: int) -> Optional[str]:
+        """Hybrid policy (hybrid_scheduling_policy.h): pack locally
+        while below the spread threshold; above it — or when local
+        can't fit — spill to a viable remote. The spillback chain is
+        bounded so two saturated raylets with stale views of each
+        other can't ping-pong a lease forever. One helper shared by
+        the single and batched lease handlers, so the policy cannot
+        diverge between them."""
+        if spillback_count >= 2:
+            return None
+        local_fits = self._fits(self.resources_available, demand)
+        utilization = 1.0 - (
+            self.resources_available.get("CPU", 0.0)
+            / max(self.resources_total.get("CPU", 1.0), 1e-9))
+        if (not local_fits or utilization
+                > ray_config().scheduler_spread_threshold):
+            return self._pick_spillback(demand)
+        return None
+
+    # ------------------------------------------------------------------
+    # placement-group bundles: 2PC reserve/commit/return (reference:
+    # node_manager.cc:1821 HandlePrepareBundleResources, :1837
+    # HandleCommitBundleResources + placement_group_resource_manager.h)
+    # ------------------------------------------------------------------
+    async def handle_prepare_bundle(self, conn: ServerConnection, *,
+                                    pg_id: str, bundle_index: int,
+                                    resources: Dict[str, float]
+                                    ) -> Dict[str, Any]:
+        key = f"{pg_id}:{bundle_index}"
+        if key in self._bundles and not self._bundles[key].removed:
+            return {"ok": True}  # idempotent re-prepare
+        demand = {k: float(v) for k, v in resources.items() if v}
+        if not self._fits(self.resources_available, demand):
+            return {"ok": False,
+                    "reason": f"insufficient resources for bundle {key}: "
+                              f"need {demand}, have "
+                              f"{self.resources_available}"}
+        self._acquire(demand)
+        n_chips = int(demand.get("TPU", 0))
+        chips, self._chips_free[:] = (self._chips_free[:n_chips],
+                                      self._chips_free[n_chips:])
+        self._bundles[key] = _Bundle(demand, chips)
+        return {"ok": True}
+
+    async def handle_commit_bundle(self, conn: ServerConnection, *,
+                                   pg_id: str, bundle_index: int) -> bool:
+        b = self._bundles.get(f"{pg_id}:{bundle_index}")
+        if b is None or b.removed:
+            return False
+        b.committed = True
+        b.committed_at = time.monotonic()
+        return True
+
+    async def handle_return_bundle(self, conn: ServerConnection, *,
+                                   pg_id: str, bundle_index: int) -> bool:
+        return self._return_bundle(f"{pg_id}:{bundle_index}")
+
+    def _return_bundle(self, key: str) -> bool:
+        b = self._bundles.get(key)
+        if b is None or b.removed:
+            return False
+        # Unused share back to the pool now; b.total shrinks to the in-use
+        # share, which drains back as each outstanding lease ends
+        # (_release_lease_resources) — empty total deletes the entry.
+        b.removed = True
+        self._release(b.available)
+        self._chips_free.extend(b.chips)
+        b.total = b.in_use()
+        b.available = {}
+        b.chips = []
+        if not b.total:
+            del self._bundles[key]
+        return True
+
+    def _reap_stale_prepares(self) -> None:
+        """Drop prepared-but-never-committed bundles (owner died between
+        the 2PC phases) so their reservations don't leak."""
+        cutoff = time.monotonic() - 30.0
+        for key, b in list(self._bundles.items()):
+            if not b.committed and not b.removed and b.prepared_at < cutoff:
+                logger.warning("returning stale uncommitted bundle %s", key)
+                self._return_bundle(key)
+
+    async def _maybe_reconcile_bundles(self) -> None:
+        """Return committed bundles whose placement group the GCS no
+        longer stands behind — the cluster-wide rollback that a crash
+        anywhere in the 2PC (owner mid-commit, GCS mid-CAS, another
+        raylet mid-prepare) cannot perform itself. _reap_stale_prepares
+        covers the reserve phase; this covers the commit phase:
+
+        - group REMOVED / INFEASIBLE / unknown -> the reservation is a
+          leak, return it now;
+        - group still not CREATED `pg_stuck_commit_s` after our commit
+          -> the owner died between commit and the CREATED CAS, return.
+
+        Throttled to one GCS round trip per `pg_reconcile_interval_s`;
+        a GCS outage skips the pass (no false rollbacks on 'unknown
+        because unreachable')."""
+        committed = {key.split(":", 1)[0]
+                     for key, b in self._bundles.items()
+                     if b.committed and not b.removed}
+        if not committed:
+            return
+        cfg = ray_config()
+        now = time.monotonic()
+        if now - self._last_bundle_reconcile < cfg.pg_reconcile_interval_s:
+            return
+        self._last_bundle_reconcile = now
+        for pg_id in committed:
+            try:
+                info = await self._gcs.get_placement_group(pg_id)
+            except Exception:
+                return  # control plane unreachable: judge nothing
+            state = (info or {}).get("state")
+            if state == "CREATED":
+                continue
+            if state == "PENDING":
+                if any(now - getattr(b, "committed_at", now)
+                       < cfg.pg_stuck_commit_s
+                       for key, b in self._bundles.items()
+                       if key.startswith(pg_id + ":") and b.committed
+                       and not b.removed):
+                    continue  # owner may still be driving the 2PC
+                # Expire the group ATOMICALLY before touching the
+                # ledger: a slow-but-live owner may be racing us toward
+                # its CREATED CAS, and returning the bundle first would
+                # manufacture a half-reserved CREATED group. Whoever
+                # wins the PENDING CAS defines the outcome — if the
+                # owner just won, our CAS misses and we keep the
+                # reservation; if we win, the owner's CREATED CAS
+                # misses and it rolls back cleanly.
+                try:
+                    won = await self._gcs.update_placement_group(
+                        pg_id, {"state": "INFEASIBLE",
+                                "detail": "committed bundle expired "
+                                          "waiting for CREATED "
+                                          f"(> {cfg.pg_stuck_commit_s}s)"},
+                        expect_state="PENDING")
+                except Exception:
+                    return  # control plane unreachable: judge nothing
+                if not won:
+                    continue  # owner terminated it; re-judge next pass
+            for key, b in list(self._bundles.items()):
+                if (key.startswith(pg_id + ":") and b.committed
+                        and not b.removed):
+                    logger.warning(
+                        "returning orphaned committed bundle %s "
+                        "(group state=%s)", key, state)
+                    from ray_tpu.core import flight
+
+                    if flight.enabled:
+                        flight.instant("pg", "pg.rollback", arg=key)
+                    self._return_bundle(key)
 
 
 class _PendingLease:
@@ -174,7 +380,7 @@ class _PullManager:
         self._return_bytes(size)
 
 
-class Raylet:
+class Raylet(NodeLedger):
     def __init__(self, *, node_id: str, gcs_address: str,
                  resources: Dict[str, float],
                  labels: Optional[Dict[str, str]] = None,
@@ -355,6 +561,10 @@ class Raylet:
             except Exception:
                 logger.warning("heartbeat to GCS failed", exc_info=True)
             self._reap_stale_prepares()
+            try:
+                await self._maybe_reconcile_bundles()
+            except Exception:
+                logger.warning("bundle reconcile failed", exc_info=True)
             self._spill_infeasible_pending()
             await asyncio.sleep(period)
 
@@ -597,6 +807,13 @@ class Raylet:
 
     def _on_node_update(self, data) -> None:
         if not data.get("alive"):
+            from ray_tpu.core import flight
+
+            if flight.enabled:
+                # Mirrors the GCS-side node.dead event into a process
+                # the dashboard's timeline fan-out actually scrapes.
+                flight.instant("node", "node.dead",
+                               arg=(data.get("node_id") or "")[:8])
             self._cluster_view.pop(data.get("node_id"), None)
 
     def _on_job_update(self, data) -> None:
@@ -688,36 +905,6 @@ class Raylet:
     # leasing + scheduling (reference: node_manager.cc:1767 +
     # cluster_task_manager.h:70 + hybrid_scheduling_policy.h:50)
     # ------------------------------------------------------------------
-    def _fits(self, avail: Dict[str, float],
-              demand: Dict[str, float]) -> bool:
-        return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
-
-    def _acquire(self, demand: Dict[str, float]) -> None:
-        for k, v in demand.items():
-            self.resources_available[k] = self.resources_available.get(
-                k, 0.0) - v
-
-    def _release(self, demand: Dict[str, float]) -> None:
-        for k, v in demand.items():
-            self.resources_available[k] = min(
-                self.resources_available.get(k, 0.0) + v,
-                self.resources_total.get(k, v))
-
-    def _pick_spillback(self, demand: Dict[str, float]) -> Optional[str]:
-        """Best remote node that can host the demand now (spread by most
-        available, the scorer's tie-break in the reference)."""
-        best, best_score = None, -1.0
-        for node_id, info in self._cluster_view.items():
-            if node_id == self.node_id or not info.get("alive"):
-                continue
-            avail = info.get("resources_available", {})
-            if not self._fits(avail, demand):
-                continue
-            score = sum(avail.get(k, 0.0) for k in ("CPU", "TPU"))
-            if score > best_score:
-                best, best_score = info["address"], score
-        return best
-
     async def handle_request_worker_lease(
             self, conn: ServerConnection, *,
             req: Optional[dict] = None,
@@ -781,29 +968,6 @@ class Raylet:
         self._pending.append(pending)
         self._try_dispatch()
         return await pending.future
-
-    def _feasible_locally(self, demand: Dict[str, float]) -> bool:
-        return self._fits(self.resources_total, demand)
-
-    def _maybe_spillback(self, demand: Dict[str, float],
-                         spillback_count: int) -> Optional[str]:
-        """Hybrid policy (hybrid_scheduling_policy.h): pack locally
-        while below the spread threshold; above it — or when local
-        can't fit — spill to a viable remote. The spillback chain is
-        bounded so two saturated raylets with stale views of each
-        other can't ping-pong a lease forever. One helper shared by
-        the single and batched lease handlers, so the policy cannot
-        diverge between them."""
-        if spillback_count >= 2:
-            return None
-        local_fits = self._fits(self.resources_available, demand)
-        utilization = 1.0 - (
-            self.resources_available.get("CPU", 0.0)
-            / max(self.resources_total.get("CPU", 1.0), 1e-9))
-        if (not local_fits or utilization
-                > ray_config().scheduler_spread_threshold):
-            return self._pick_spillback(demand)
-        return None
 
     async def handle_request_worker_leases(
             self, conn: ServerConnection, *,
@@ -1279,69 +1443,6 @@ class Raylet:
         return True
 
     # ------------------------------------------------------------------
-    # placement-group bundles: 2PC reserve/commit/return (reference:
-    # node_manager.cc:1821 HandlePrepareBundleResources, :1837
-    # HandleCommitBundleResources + placement_group_resource_manager.h)
-    # ------------------------------------------------------------------
-    async def handle_prepare_bundle(self, conn: ServerConnection, *,
-                                    pg_id: str, bundle_index: int,
-                                    resources: Dict[str, float]
-                                    ) -> Dict[str, Any]:
-        key = f"{pg_id}:{bundle_index}"
-        if key in self._bundles and not self._bundles[key].removed:
-            return {"ok": True}  # idempotent re-prepare
-        demand = {k: float(v) for k, v in resources.items() if v}
-        if not self._fits(self.resources_available, demand):
-            return {"ok": False,
-                    "reason": f"insufficient resources for bundle {key}: "
-                              f"need {demand}, have "
-                              f"{self.resources_available}"}
-        self._acquire(demand)
-        n_chips = int(demand.get("TPU", 0))
-        chips, self._chips_free[:] = (self._chips_free[:n_chips],
-                                      self._chips_free[n_chips:])
-        self._bundles[key] = _Bundle(demand, chips)
-        return {"ok": True}
-
-    async def handle_commit_bundle(self, conn: ServerConnection, *,
-                                   pg_id: str, bundle_index: int) -> bool:
-        b = self._bundles.get(f"{pg_id}:{bundle_index}")
-        if b is None or b.removed:
-            return False
-        b.committed = True
-        return True
-
-    async def handle_return_bundle(self, conn: ServerConnection, *,
-                                   pg_id: str, bundle_index: int) -> bool:
-        return self._return_bundle(f"{pg_id}:{bundle_index}")
-
-    def _return_bundle(self, key: str) -> bool:
-        b = self._bundles.get(key)
-        if b is None or b.removed:
-            return False
-        # Unused share back to the pool now; b.total shrinks to the in-use
-        # share, which drains back as each outstanding lease ends
-        # (_release_lease_resources) — empty total deletes the entry.
-        b.removed = True
-        self._release(b.available)
-        self._chips_free.extend(b.chips)
-        b.total = b.in_use()
-        b.available = {}
-        b.chips = []
-        if not b.total:
-            del self._bundles[key]
-        return True
-
-    def _reap_stale_prepares(self) -> None:
-        """Drop prepared-but-never-committed bundles (owner died between
-        the 2PC phases) so their reservations don't leak."""
-        cutoff = time.monotonic() - 30.0
-        for key, b in list(self._bundles.items()):
-            if not b.committed and not b.removed and b.prepared_at < cutoff:
-                logger.warning("returning stale uncommitted bundle %s", key)
-                self._return_bundle(key)
-
-    # ------------------------------------------------------------------
     # object store RPCs (reference: plasma protocol + object_manager)
     # ------------------------------------------------------------------
     async def _store_io(self, fn, *args):
@@ -1561,6 +1662,7 @@ class Raylet:
         `ray.get` with no user timeout must not be capped server-side)."""
         deadline = (None if pull_timeout is None
                     else time.monotonic() + pull_timeout)
+        owner_unreachable_since: Optional[float] = None
         while deadline is None or time.monotonic() < deadline:
             info = await self._store_io(self.store.info, oid)
             if info is not None:
@@ -1575,7 +1677,24 @@ class Raylet:
                     loc = await owner.call("get_object_locations", oid=oid,
                                            timeout=10.0)
                 except Exception as e:
-                    return {"error": f"owner unreachable: {e}"}
+                    # An unreachable owner is transient (restarting GCS,
+                    # blip) until it has stayed unreachable for the
+                    # grace window — then it is DEAD and the borrower's
+                    # get must fail loudly as OwnerDiedError, not hang
+                    # in this loop or mislabel the loss as a generic
+                    # ObjectLostError (reference: ownership model,
+                    # OBJECT_UNRECOVERABLE_OWNER_DIED).
+                    now = time.monotonic()
+                    if owner_unreachable_since is None:
+                        owner_unreachable_since = now
+                    if (now - owner_unreachable_since
+                            >= ray_config().owner_unreachable_grace_s):
+                        return {"error": f"owner unreachable: {e}",
+                                "owner_dead": True}
+                    await asyncio.sleep(
+                        ray_config().object_timeout_ms / 1000.0)
+                    continue
+                owner_unreachable_since = None
                 if loc is None:
                     return {"error": "owner does not know this object"}
                 if loc.get("inline") is not None:

@@ -30,6 +30,7 @@ import asyncio
 import concurrent.futures
 import logging
 import struct
+import sys
 import threading
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
@@ -38,6 +39,13 @@ import msgpack
 from ray_tpu.core import attribution
 
 logger = logging.getLogger(__name__)
+
+
+def _faults_enabled() -> bool:
+    """True only when core/faults.py is loaded AND armed — the hot path
+    pays a dict lookup, never an import, when fault injection is off."""
+    faults = sys.modules.get("ray_tpu.core.faults")
+    return faults is not None and faults.enabled
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 512 * 1024 * 1024
@@ -319,6 +327,31 @@ class ServerConnection:
             await self._reply(req_id, ok=False,
                               error=f"no such method: {method}")
             return
+        if _faults_enabled():
+            # Deterministic fault injection (core/faults.py): a drop rule
+            # swallows the request here — the client sees a timeout /
+            # ConnectionLost exactly as if the frame died on the wire; a
+            # duplicate rule dispatches the handler a second time with
+            # its reply discarded (at-least-once delivery). The
+            # duplicate runs CONCURRENTLY, as real redelivery would — an
+            # inline await of a handler that parks (e.g. a queued lease)
+            # would stall the genuine dispatch behind it.
+            from ray_tpu.core import faults
+
+            try:
+                duplicate = await faults.on_server_dispatch(method)
+            except faults.FaultInjected:
+                return
+
+            if duplicate:
+                async def _dup():
+                    try:
+                        await handler(self, **(msg.get("a") or {}))
+                    except Exception:
+                        logger.debug("duplicated handler %s failed",
+                                     method, exc_info=True)
+
+                asyncio.ensure_future(_dup())
         try:
             result = await handler(self, **(msg.get("a") or {}))
             await self._reply(req_id, ok=True, result=result)
@@ -469,6 +502,12 @@ class RpcClient:
         (see module docstring)."""
         if not self.connected:
             raise ConnectionLost(f"not connected to {self.address}")
+        if _faults_enabled():
+            # Client-side injection point (core/faults.py): drops raise
+            # ConnectionLost, delays sleep before the frame is written.
+            from ray_tpu.core import faults
+
+            await faults.on_client_call(self.address, method)
         self._next_id += 1
         req_id = self._next_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()

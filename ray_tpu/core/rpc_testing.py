@@ -90,6 +90,14 @@ class LoopbackClient:
         self.conn: Optional[ServerConnection] = None
         self.connected = False
         self._next_id = 0
+        self._push_handlers: Dict[str, Any] = {}
+
+    def on_push(self, channel: str, handler: Any) -> None:
+        """Mirror of RpcClient.on_push: server pushes recorded into the
+        fake writer are routed to `handler` as they are decoded (used by
+        pubsub-consuming callers, e.g. a GcsClient bound to a loopback
+        channel in core/simcluster.py)."""
+        self._push_handlers[channel] = handler
 
     async def connect(self, handshake: bool = True,
                       digest: Optional[Dict[str, int]] = None) -> None:
@@ -113,10 +121,20 @@ class LoopbackClient:
         await self.conn._dispatch(body)
         self.conn._batch.flush()
         replies = _decode_frames(self.conn._writer)
+        out = None
         for r in replies:
-            if r.get("i") == body.get("i"):
-                return r
-        return None
+            if "push" in r:
+                # Route server pushes (pubsub deliveries) like the TCP
+                # client's read loop does instead of dropping them on
+                # the floor of the fake writer.
+                handler = self._push_handlers.get(r["push"])
+                if handler is not None:
+                    res = handler(r.get("d"))
+                    if asyncio.iscoroutine(res):
+                        await res
+            elif r.get("i") == body.get("i"):
+                out = r
+        return out
 
     async def call(self, method: str, timeout: Optional[float] = 60.0,
                    **args: Any) -> Any:

@@ -1,0 +1,620 @@
+"""Simulated-raylet scale harness: 100+-node control-plane scenarios in
+one pytest process, in seconds.
+
+The scheduler and GCS had only ever run on a handful of OS processes;
+"survives at 100 nodes" was an untested claim. This module scales the
+loopback-fake approach of `core/rpc_testing.py` into a whole cluster:
+
+- ONE real `GcsServer` (storage, WAL, health loop, every handler) runs
+  with `serve_rpc=False` — no TCP listener, but the full control plane;
+- N `SimRaylet`s inherit the real raylet's `NodeLedger` (resource
+  accounting, placement-group 2PC handlers, spillback policy) and speak
+  to the GCS through the real `GcsClient` accessors over in-process
+  loopback `ServerConnection` dispatch — production wire typing,
+  production handlers, zero sockets;
+- a `SimDriver` creates placement groups through the SAME
+  `schedule_placement_group` coroutine the real runtime uses, and
+  submits simulated task leases with the real retry discipline
+  (ConnectionLost -> jittered backoff -> other node);
+- every message crosses `FaultPlan.apply` (core/faults.py): seeded
+  drops, delays, duplicates, one-way partitions and crash-on-nth are a
+  replayable property of the seed, so "the cluster leaked a bundle
+  under seed 17" is a failing test, not an anecdote.
+
+Used by tests/test_unit_simcluster.py (`-m unit`) and
+`python -m ray_tpu.perf --simcluster`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import random
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.cluster_runtime import schedule_placement_group
+from ray_tpu.core.config import ray_config
+from ray_tpu.core.faults import FaultPlan
+from ray_tpu.core.gcs.client import GcsClient, backoff_delay
+from ray_tpu.core.gcs.server import GcsServer
+from ray_tpu.core.raylet import NodeLedger, _Bundle  # noqa: F401 (re-export)
+from ray_tpu.core.rpc import ConnectionLost
+from ray_tpu.core.rpc_testing import LoopbackClient
+
+logger = logging.getLogger(__name__)
+
+# Control-plane timings compressed ~10x so a restart+grace+reconcile
+# cycle fits in a unit-test second; every value is the REAL config knob,
+# just smaller — the code paths cannot tell the difference.
+SIM_CONFIG = {
+    "health_check_period_ms": 100,
+    "health_check_failure_threshold": 3,
+    "raylet_heartbeat_period_ms": 50,
+    "gcs_rpc_timeout_s": 8.0,
+    "gcs_reconnect_backoff_base_ms": 10.0,
+    "gcs_reconnect_backoff_max_ms": 250.0,
+    "pg_reconcile_interval_s": 0.25,
+    "pg_stuck_commit_s": 2.0,
+}
+
+
+class _SimChannel:
+    """The client half of one simulated connection (src -> dst), with
+    `_ReconnectingRpc` semantics: a ConnectionLost call retries with the
+    SAME capped-exponential-jitter backoff the real GCS client uses,
+    within the same `gcs_rpc_timeout_s` window. Satisfies the interface
+    `GcsClient` needs from its rpc."""
+
+    def __init__(self, cluster: "SimCluster", src: str, dst: str,
+                 retry_window: bool = True):
+        self._cluster = cluster
+        self.src = src
+        self.dst = dst
+        self._retry_window = retry_window
+        self.connected = True
+
+    async def connect(self, timeout: float = 10.0) -> None:
+        return None
+
+    async def close(self) -> None:
+        self.connected = False
+
+    def on_push(self, channel: str, handler) -> None:
+        pass  # sim components don't subscribe
+
+    def mark_subscribed(self, channel: str) -> None:
+        pass
+
+    async def call(self, method: str, timeout: Optional[float] = 60.0,
+                   **kwargs: Any) -> Any:
+        try:
+            return await self._cluster.dispatch(self.src, self.dst, method,
+                                                kwargs)
+        except ConnectionLost:
+            if not self._retry_window:
+                raise
+        # Reconnect-retry (mirrors _ReconnectingRpc.call + _reconnect):
+        # keep trying with jittered backoff until the window closes.
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + ray_config().gcs_rpc_timeout_s
+        attempt = 0
+        while True:
+            await asyncio.sleep(backoff_delay(attempt))
+            attempt += 1
+            try:
+                return await self._cluster.dispatch(self.src, self.dst,
+                                                    method, kwargs)
+            except ConnectionLost:
+                if loop.time() >= deadline:
+                    raise
+
+
+class _RayletCaller:
+    """What `schedule_placement_group` sees as a raylet client: `.call`
+    routed through the fault plan to the sim raylet that owns the
+    address. No retry window — the 2PC's own failure handling must see
+    raw ConnectionLost, exactly as over TCP."""
+
+    def __init__(self, cluster: "SimCluster", src: str, address: str):
+        self._cluster = cluster
+        self._src = src
+        self._address = address
+
+    async def call(self, method: str, timeout: Optional[float] = 60.0,
+                   **kwargs: Any) -> Any:
+        dst = self._cluster.node_by_address(self._address)
+        if dst is None:
+            raise ConnectionLost(f"no sim node at {self._address}")
+        return await self._cluster.dispatch(self._src, dst, method, kwargs)
+
+
+class SimRaylet(NodeLedger):
+    """A raylet reduced to its control-plane brain: the real NodeLedger
+    (2PC bundle handlers, resource accounting, spillback policy) plus
+    the real heartbeat/re-register/reconcile contract — no worker
+    processes, no object store, no sockets."""
+
+    def __init__(self, cluster: "SimCluster", node_id: str,
+                 resources: Dict[str, float]):
+        self.cluster = cluster
+        self.node_id = node_id
+        self.address = f"sim:{node_id}"
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self._bundles: Dict[str, _Bundle] = {}
+        self._chips_free: List[int] = list(
+            range(int(resources.get("TPU", 0))))
+        self._cluster_view: Dict[str, Dict[str, Any]] = {}
+        self.alive = True
+        self.registered = False
+        self.lease_grants = 0
+        self._next_lease = 0
+        self._leases: Dict[str, Tuple[Dict[str, float], Optional[str]]] = {}
+        # At-least-once protection: a duplicated/retried lease request
+        # must not acquire twice (mirrors the real raylet's
+        # _recent_grants reclaim machinery, simplified to a reply cache).
+        self._granted_by_request: Dict[str, Dict[str, Any]] = {}
+        self._gcs = GcsClient(self.address,
+                              rpc=_SimChannel(cluster, node_id, "gcs"))
+        self._hb_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        await self._register_with_gcs()
+        self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+
+    async def _register_with_gcs(self) -> None:
+        await self._gcs.register_node(
+            node_id=self.node_id, address=self.address,
+            object_store_address=self.address,
+            resources=self.resources_total, labels={}, is_head=False)
+        self.registered = True
+
+    async def _heartbeat_loop(self) -> None:
+        """The real raylet's heartbeat contract (raylet.py
+        _heartbeat_loop): report resources, re-register on a False
+        reply, refresh the cluster view, reap/reconcile bundles. GCS
+        outages back off with the shared jittered delay."""
+        period = ray_config().raylet_heartbeat_period_ms / 1000.0
+        attempt = 0
+        while self.alive:
+            try:
+                ok = await self._gcs.heartbeat(
+                    self.node_id, self.resources_available,
+                    load={"pending": 0})
+                if ok is False:
+                    await self._register_with_gcs()
+                self._cluster_view = {
+                    n["node_id"]: n for n in await self._gcs.get_nodes()}
+                attempt = 0
+            except Exception:
+                await asyncio.sleep(backoff_delay(attempt))
+                attempt += 1
+            self._reap_stale_prepares()
+            try:
+                await self._maybe_reconcile_bundles()
+            except Exception:
+                logger.debug("sim reconcile failed", exc_info=True)
+            await asyncio.sleep(period)
+
+    def crash(self) -> None:
+        """kill -9 equivalent: the ledger dies with the process; every
+        in-flight call to this node sees ConnectionLost."""
+        self.alive = False
+        self.registered = False
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+
+    async def stop(self) -> None:
+        self.alive = False
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            try:
+                await self._hb_task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    # -- simulated task leases ------------------------------------------
+    async def handle_request_sim_lease(
+            self, conn, *, resources: Dict[str, float],
+            request_id: Optional[str] = None,
+            spillback_count: int = 0,
+            bundle: Optional[List[Any]] = None) -> Dict[str, Any]:
+        """Chip-less worker lease against the ledger — grant, spillback
+        (via the REAL `_maybe_spillback` hybrid policy), or reject.
+        Idempotent per request_id: at-least-once delivery (retries,
+        duplicate injection) must never double-acquire."""
+        if request_id is not None:
+            cached = self._granted_by_request.get(request_id)
+            if cached is not None:
+                return cached
+        demand = {k: float(v) for k, v in resources.items() if v}
+        reply: Dict[str, Any]
+        if bundle is not None:
+            key = f"{bundle[0]}:{bundle[1]}"
+            b = self._bundles.get(key)
+            if b is None or b.removed:
+                return {"error": "bundle_missing"}
+            if not self._fits(b.available, demand):
+                return {"error": "infeasible"}
+            for k, v in demand.items():
+                b.available[k] = b.available.get(k, 0.0) - v
+            bundle_key: Optional[str] = key
+        else:
+            remote = self._maybe_spillback(demand, spillback_count)
+            if remote is not None:
+                return {"spillback": remote}
+            if not self._fits(self.resources_available, demand):
+                # The sim keeps no pending queue: the driver's retry
+                # loop is the queue (bounded, jittered).
+                return {"error": "infeasible"}
+            self._acquire(demand)
+            bundle_key = None
+        self._next_lease += 1
+        lease_id = f"{self.node_id}#{self._next_lease}"
+        self._leases[lease_id] = (demand, bundle_key)
+        self.lease_grants += 1
+        reply = {"lease_id": lease_id, "node_id": self.node_id}
+        if request_id is not None:
+            self._granted_by_request[request_id] = reply
+            if len(self._granted_by_request) > 4096:
+                for k in itertools.islice(
+                        iter(list(self._granted_by_request)), 2048):
+                    self._granted_by_request.pop(k, None)
+        return reply
+
+    async def handle_return_sim_lease(self, conn, *,
+                                      lease_id: str) -> bool:
+        rec = self._leases.pop(lease_id, None)
+        if rec is None:
+            return True  # duplicate return: already released
+        demand, bundle_key = rec
+        if bundle_key is not None:
+            b = self._bundles.get(bundle_key)
+            if b is not None and not b.removed:
+                for k, v in demand.items():
+                    b.available[k] = min(b.available.get(k, 0.0) + v,
+                                         b.total.get(k, v))
+            else:
+                self._release(demand)
+        else:
+            self._release(demand)
+        return True
+
+    async def handle_node_stats(self, conn) -> Dict[str, Any]:
+        return {
+            "node_id": self.node_id,
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "bundles": {k: {"total": b.total, "available": b.available,
+                            "committed": b.committed}
+                        for k, b in self._bundles.items() if not b.removed},
+            "leases": len(self._leases),
+        }
+
+
+class SimDriver:
+    """The owner side: creates placement groups through the runtime's
+    `schedule_placement_group` and submits simulated tasks with the
+    production retry discipline. Tracks completion so the acceptance
+    invariant ("zero lost tasks") is a list comparison."""
+
+    def __init__(self, cluster: "SimCluster", name: str = "driver"):
+        self.cluster = cluster
+        self.name = name
+        self._gcs = GcsClient(f"sim:{name}",
+                              rpc=_SimChannel(cluster, name, "gcs"))
+        self._rng = random.Random(cluster.seed ^ 0x5eed)
+        self._next_task = 0
+        self._next_pg = 0
+        self.completed: List[str] = []
+        self.lost: List[str] = []
+
+    async def raylet_client_for(self, address: str) -> _RayletCaller:
+        return _RayletCaller(self.cluster, self.name, address)
+
+    # -- placement groups ----------------------------------------------
+    async def create_placement_group(self, bundles: List[Dict[str, float]],
+                                     strategy: str = "PACK",
+                                     attempts: int = 8
+                                     ) -> Tuple[str, str]:
+        self._next_pg += 1
+        pg_id = f"simpg{self.cluster.seed:x}n{self._next_pg:05d}"
+        info = {"bundles": [dict(b) for b in bundles],
+                "strategy": strategy, "name": "", "state": "PENDING",
+                "owner": self.name, "target_node_ids": None}
+        await self._gcs.register_placement_group(pg_id, info)
+        state = await schedule_placement_group(
+            self._gcs, self.raylet_client_for, pg_id, info,
+            attempts=attempts)
+        return pg_id, state
+
+    async def remove_placement_group(self, pg_id: str) -> None:
+        """REMOVED is recorded FIRST, then bundles are returned: any
+        return that fails (drop, dead node) is mopped up by raylet-side
+        reconciliation against the terminal state — the reverse order
+        can strand committed bundles behind a forever-CREATED record."""
+        info = await self._gcs.get_placement_group(pg_id)
+        if info is None or info.get("state") == "REMOVED":
+            return
+        await self._gcs.update_placement_group(pg_id, {"state": "REMOVED"})
+        for idx, loc in enumerate(info.get("bundle_locations") or []):
+            try:
+                client = await self.raylet_client_for(loc["address"])
+                await client.call("return_bundle", pg_id=pg_id,
+                                  bundle_index=idx)
+            except ConnectionLost:
+                pass  # reconciler returns it against the REMOVED state
+
+    # -- simulated tasks -----------------------------------------------
+    async def submit_task(self, resources: Optional[Dict[str, float]]
+                          = None, hold_s: float = 0.0,
+                          max_attempts: int = 60) -> bool:
+        """One simulated task: lease -> hold -> return, surviving
+        ConnectionLost/spillback/infeasible with the jittered-backoff
+        retry discipline of the real submit path. Returns True when the
+        task completed (and records it); False only after the retry
+        budget is exhausted (records into .lost)."""
+        demand = dict(resources or {"CPU": 1.0})
+        self._next_task += 1
+        task_id = f"{self.name}-t{self._next_task:06d}"
+        for attempt in range(max_attempts):
+            node = self._pick_node()
+            if node is None:
+                await asyncio.sleep(backoff_delay(attempt, self._rng))
+                continue
+            try:
+                reply = await self._lease_chain(node, demand, task_id)
+            except ConnectionLost:
+                await asyncio.sleep(backoff_delay(attempt, self._rng))
+                continue
+            if reply is None or "lease_id" not in reply:
+                await asyncio.sleep(backoff_delay(attempt, self._rng))
+                continue
+            if hold_s:
+                await asyncio.sleep(hold_s)
+            await self._return_lease(reply["node_id"], reply["lease_id"])
+            self.completed.append(task_id)
+            return True
+        self.lost.append(task_id)
+        return False
+
+    async def _lease_chain(self, node: str, demand: Dict[str, float],
+                           task_id: str) -> Optional[Dict[str, Any]]:
+        """Follow spillback redirects like the real lease client (bounded
+        chain, same request_id so at-least-once stays single-grant
+        per target)."""
+        spill = 0
+        while True:
+            reply = await self.cluster.dispatch(
+                self.name, node, "request_sim_lease",
+                {"resources": demand, "request_id": f"{task_id}@{node}",
+                 "spillback_count": spill})
+            target = reply.get("spillback") if reply else None
+            if target is None:
+                return reply
+            nxt = self.cluster.node_by_address(target)
+            if nxt is None:
+                return None
+            node, spill = nxt, spill + 1
+
+    async def _return_lease(self, node: str, lease_id: str) -> None:
+        for attempt in range(20):
+            if not self.cluster.is_alive(node):
+                return  # lease died with the node; nothing to release
+            try:
+                await self.cluster.dispatch(self.name, node,
+                                            "return_sim_lease",
+                                            {"lease_id": lease_id})
+                return
+            except ConnectionLost:
+                await asyncio.sleep(backoff_delay(attempt, self._rng))
+        logger.warning("lease %s on %s could not be returned", lease_id,
+                       node)
+
+    def _pick_node(self) -> Optional[str]:
+        live = [n for n, r in self.cluster.raylets.items() if r.alive]
+        if not live:
+            return None
+        return self._rng.choice(live)
+
+
+class SimCluster:
+    """N simulated raylets + one real GcsServer + a fault plan, in one
+    event loop."""
+
+    def __init__(self, num_nodes: int = 100, *,
+                 resources: Optional[Dict[str, float]] = None,
+                 seed: int = 0,
+                 storage_path: Optional[str] = None,
+                 plan: Optional[FaultPlan] = None,
+                 config: Optional[Dict[str, Any]] = None):
+        self.num_nodes = num_nodes
+        self.seed = seed
+        self.node_resources = dict(resources or {"CPU": 4.0})
+        self.storage_path = storage_path
+        self.plan = plan if plan is not None else FaultPlan(seed)
+        self._config_overrides = {**SIM_CONFIG, **(config or {})}
+        self._saved_config: Optional[Dict[str, Any]] = None
+        self.gcs: Optional[GcsServer] = None
+        self.gcs_epoch = 0
+        self.raylets: Dict[str, SimRaylet] = {}
+        self._by_address: Dict[str, str] = {}
+        # (src, dst, epoch) -> LoopbackClient bound to the live target
+        self._conns: Dict[Tuple[str, str, int], LoopbackClient] = {}
+        self.driver = SimDriver(self)
+
+    # -- lifecycle ------------------------------------------------------
+    async def start(self) -> None:
+        cfg = ray_config()
+        self._saved_config = dict(cfg._values)
+        cfg.apply_system_config(self._config_overrides)
+        self._wire_crashes()
+        self.gcs = GcsServer(storage_path=self.storage_path)
+        await self.gcs.start(serve_rpc=False)
+        for i in range(self.num_nodes):
+            node_id = f"simnode{i:04d}"
+            raylet = SimRaylet(self, node_id, self.node_resources)
+            self.raylets[node_id] = raylet
+            self._by_address[raylet.address] = node_id
+        await asyncio.gather(*(r.start() for r in self.raylets.values()))
+
+    async def stop(self) -> None:
+        for r in self.raylets.values():
+            await r.stop()
+        if self.gcs is not None:
+            await self.gcs.stop()
+            self.gcs = None
+        if self._saved_config is not None:
+            cfg = ray_config()
+            cfg._values.clear()
+            cfg._values.update(self._saved_config)
+            self._saved_config = None
+
+    def _wire_crashes(self) -> None:
+        """Give crash rules without a callback the cluster's kill switch
+        (dst 'gcs' -> kill_gcs; a node id -> crash_raylet)."""
+        for rule in self.plan.rules:
+            if rule.kind == "crash" and rule.on_crash is None:
+                rule.on_crash = self.crash_target
+
+    def crash_target(self, dst: str) -> None:
+        if dst == "gcs":
+            self.kill_gcs()
+        elif dst in self.raylets:
+            self.crash_raylet(dst)
+
+    # -- fault-injected message plane -----------------------------------
+    def node_by_address(self, address: str) -> Optional[str]:
+        return self._by_address.get(address)
+
+    def is_alive(self, dst: str) -> bool:
+        if dst == "gcs":
+            return self.gcs is not None
+        r = self.raylets.get(dst)
+        return r is not None and r.alive
+
+    def _target(self, dst: str) -> Optional[Any]:
+        if dst == "gcs":
+            return self.gcs
+        r = self.raylets.get(dst)
+        return r if (r is not None and r.alive) else None
+
+    async def _client(self, src: str, dst: str,
+                      target: Any) -> LoopbackClient:
+        key = (src, dst, self.gcs_epoch if dst == "gcs" else 0)
+        client = self._conns.get(key)
+        if client is None or client.handlers is not target:
+            client = LoopbackClient(target)
+            # Handshake through the real __schema__ dispatch once per
+            # (src, dst, epoch) — connect-time traffic is not
+            # fault-injected, matching TCP (faults sit on calls).
+            await client.connect()
+            self._conns[key] = client
+        return client
+
+    async def dispatch(self, src: str, dst: str, method: str,
+                       kwargs: Dict[str, Any]) -> Any:
+        """One message src -> dst through the fault plan, then the real
+        ServerConnection dispatch of the target. A target that dies
+        while the handler runs loses the REPLY too (kill -9 semantics):
+        the caller sees ConnectionLost even though the zombie handler
+        finished against the dead instance's discarded state."""
+        duplicate = await self.plan.apply(src, dst, method)
+        target = self._target(dst)
+        if target is None:
+            raise ConnectionLost(f"sim target {dst} is down")
+        epoch = self.gcs_epoch
+        client = await self._client(src, dst, target)
+        if duplicate:
+            async def _dup():
+                try:
+                    await client.call(method, **kwargs)
+                except Exception:
+                    pass  # the duplicate's outcome is invisible
+
+            asyncio.ensure_future(_dup())
+        result = await client.call(method, **kwargs)
+        if dst == "gcs":
+            if self.gcs_epoch != epoch:
+                raise ConnectionLost("gcs died before replying")
+        elif not self.is_alive(dst):
+            raise ConnectionLost(f"sim target {dst} died before replying")
+        return result
+
+    # -- chaos controls -------------------------------------------------
+    def kill_gcs(self) -> None:
+        """kill -9: no final flush, loops die mid-flight; only
+        WAL-acked state survives to the next epoch. In-flight handler
+        coroutines of the killed instance cannot be preempted in-process
+        — so their replies are discarded by the epoch check in
+        dispatch(), and storage is severed HERE so a zombie flush can't
+        append to the WAL the next epoch replays."""
+        if self.gcs is None:
+            return
+        if self.gcs._health_task is not None:
+            self.gcs._health_task.cancel()
+        if self.gcs._snapshot_task is not None:
+            self.gcs._snapshot_task.cancel()
+        self.gcs._storage_path = None
+        self.gcs = None
+        self.gcs_epoch += 1
+
+    async def restart_gcs(self) -> None:
+        assert self.storage_path, "restart needs persistent storage"
+        self.gcs = GcsServer(storage_path=self.storage_path)
+        await self.gcs.start(serve_rpc=False)
+        self.gcs_epoch += 1
+
+    def crash_raylet(self, node_id: str) -> None:
+        raylet = self.raylets.get(node_id)
+        if raylet is not None:
+            raylet.crash()
+
+    # -- invariants -----------------------------------------------------
+    def alive_raylets(self) -> List[SimRaylet]:
+        return [r for r in self.raylets.values() if r.alive]
+
+    def leaked_reservations(self) -> List[Tuple[str, str, Any]]:
+        """Bundles held by live raylets that the control plane does not
+        stand behind: every entry is a capacity leak."""
+        assert self.gcs is not None
+        out = []
+        for r in self.alive_raylets():
+            for key, b in r._bundles.items():
+                if b.removed:
+                    continue
+                pg_id = key.split(":", 1)[0]
+                state = (self.gcs.placement_groups.get(pg_id)
+                         or {}).get("state")
+                if state != "CREATED":
+                    out.append((r.node_id, key, state))
+        return out
+
+    def resource_violations(self) -> List[Tuple[str, Dict, Dict]]:
+        """Live raylets with no leases and no bundles must be back at
+        full capacity — anything else leaked through a retry path."""
+        out = []
+        for r in self.alive_raylets():
+            if r._leases or any(not b.removed
+                                for b in r._bundles.values()):
+                continue
+            if any(abs(r.resources_available.get(k, 0.0) - v) > 1e-6
+                   for k, v in r.resources_total.items()):
+                out.append((r.node_id, dict(r.resources_available),
+                            dict(r.resources_total)))
+        return out
+
+    def registered_count(self) -> int:
+        assert self.gcs is not None
+        return sum(1 for n in self.gcs.nodes.values() if n.get("alive"))
+
+    async def wait_until(self, predicate, timeout: float = 10.0,
+                         interval: float = 0.05) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            await asyncio.sleep(interval)
+        return predicate()

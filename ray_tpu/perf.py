@@ -463,6 +463,63 @@ def run_flight_overhead_bench(scale: float = 1.0,
     return out
 
 
+def run_simcluster_bench(n_nodes: int = 100,
+                         scale: float = 1.0) -> Dict[str, Any]:
+    """Control-plane throughput at N simulated nodes (ISSUE 14): lease
+    grants/s through the real spillback policy and placement-group
+    creations/s through the real 2PC, measured against one real
+    GcsServer with `n_nodes` in-process raylets (core/simcluster.py).
+    No OS processes, no sockets — the numbers isolate the control
+    plane's own code from box fork/exec noise, so a regression here is
+    a scheduling/GCS-path regression, full stop."""
+    import asyncio
+
+    from ray_tpu.core.simcluster import SimCluster
+
+    n_tasks = max(50, int(400 * scale))
+    n_pgs = max(8, int(40 * scale))
+
+    async def bench() -> Dict[str, Any]:
+        cluster = SimCluster(num_nodes=n_nodes, seed=0)
+        await cluster.start()
+        try:
+            assert await cluster.wait_until(
+                lambda: cluster.registered_count() == n_nodes, timeout=60)
+            # Warm the cluster views so spillback has a world model.
+            await asyncio.gather(*(cluster.driver.submit_task()
+                                   for _ in range(20)))
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*(cluster.driver.submit_task()
+                                   for _ in range(n_tasks)))
+            lease_dt = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            created = await asyncio.gather(
+                *(cluster.driver.create_placement_group(
+                    [{"CPU": 1.0}] * 4, strategy="SPREAD")
+                  for _ in range(n_pgs)))
+            await asyncio.gather(
+                *(cluster.driver.remove_placement_group(pg_id)
+                  for pg_id, _ in created))
+            pg_dt = time.perf_counter() - t0
+
+            assert not cluster.driver.lost
+            assert all(state == "CREATED" for _, state in created), (
+                [s for _, s in created])
+            leaked = cluster.leaked_reservations()
+            return {
+                "sim_nodes": n_nodes,
+                "lease_grants_per_s": round(n_tasks / lease_dt, 1),
+                "placements_per_s": round(n_pgs / pg_dt, 1),
+                "sim_leaked_reservations": len(leaked),
+            }
+        finally:
+            await cluster.stop()
+
+    return asyncio.run(bench())
+
+
 def run_llm_serve_bench(scale: float = 1.0) -> Dict[str, Any]:
     """LLM-serving scenario: the continuous-batching engine vs the
     `@serve.batch`-style static policy on the SAME mixed-length
@@ -679,9 +736,20 @@ def main() -> None:
     p.add_argument("--flight-overhead", action="store_true",
                    help="measure recorder-on vs recorder-off tasks/s "
                         "(the <=10%% 'cheap when on' pin)")
+    p.add_argument("--simcluster", action="store_true",
+                   help="run ONLY the simulated-raylet control-plane "
+                        "bench: lease grants/s and placement-group "
+                        "creations/s at --sim-nodes in-process nodes "
+                        "against a real GcsServer; no cluster processes")
+    p.add_argument("--sim-nodes", type=int, default=100,
+                   help="node count for --simcluster (default 100)")
     args = p.parse_args()
     import ray_tpu
 
+    if args.simcluster:
+        print(json.dumps(run_simcluster_bench(n_nodes=args.sim_nodes,
+                                              scale=args.scale)))
+        return
     if args.llm_serve:
         print(json.dumps(run_llm_serve_bench(scale=args.scale)))
         return

@@ -125,3 +125,85 @@ def test_wal_torn_tail_truncated_before_new_appends(tmp_path):
             "acked write after a torn tail was silently dropped")
 
     asyncio.run(scenario())
+
+
+def test_gcs_kill9_mid_pg_creation_never_half_reserved(ray_cluster):
+    """Chaos (ISSUE 14 satellite): kill -9 the GCS between the 2PC's
+    reserve and commit phases. After restart the placement group either
+    fully materializes or is cleanly rejected — never a half-reserved
+    bundle set leaking node capacity.
+
+    The window is landed deterministically with the fault-injection
+    layer (core/faults.py hooked into the driver's real RpcClient):
+    every driver->raylet commit_bundle is delayed, so the kill lands
+    while bundles are prepared-but-uncommitted."""
+    import ray_tpu
+    from ray_tpu.core import faults
+    from ray_tpu.util import state
+    from ray_tpu.util.placement_group import (placement_group,
+                                              placement_group_table,
+                                              remove_placement_group)
+
+    node = ray_tpu._private_node()
+    assert node is not None
+    raylet_addr = node.raylet_address
+
+    plan = faults.FaultPlan(seed=0)
+    plan.delay(method="commit_bundle", p=1.0, delay_s=1.5)
+    faults.install(plan)
+    try:
+        pg = placement_group([{"CPU": 1.0}, {"CPU": 1.0}],
+                             strategy="PACK")
+        # Both bundles prepare immediately; commits are held 1.5 s each.
+        # Kill the control plane inside that window.
+        time.sleep(0.5)
+        node.kill_gcs()
+        time.sleep(1.0)
+        node.restart_gcs()
+
+        # The owner-side 2PC finishes against the restarted GCS (the
+        # reconnecting client retries the CREATED CAS) or gives up and
+        # rolls back; both are legal — PENDING forever is not.
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            info = placement_group_table(pg) or {}
+            if info.get("state") in ("CREATED", "INFEASIBLE", "REMOVED"):
+                break
+            time.sleep(0.5)
+        final = (placement_group_table(pg) or {}).get("state")
+        assert final in ("CREATED", "INFEASIBLE", "REMOVED"), (
+            f"placement group stuck in {final!r} after GCS restart")
+    finally:
+        faults.uninstall()
+
+    # No half-reserved bundles: the raylet's ledger must agree with the
+    # terminal state — both bundles committed for CREATED, none
+    # otherwise (reaper/reconciler return the strays).
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        bundles = state.node_stats(raylet_addr).get("bundles", {})
+        if final == "CREATED":
+            if (len(bundles) == 2
+                    and all(b["committed"] for b in bundles.values())):
+                break
+        elif not bundles:
+            break
+        time.sleep(0.5)
+    assert (len(bundles) == 2 if final == "CREATED" else not bundles), (
+        final, bundles)
+
+    # And removal drains the reservation fully — zero leaked capacity.
+    if final == "CREATED":
+        remove_placement_group(pg)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        stats = state.node_stats(raylet_addr)
+        if (not stats.get("bundles")
+                and stats["resources_available"].get("CPU")
+                == stats["resources_total"].get("CPU")):
+            break
+        time.sleep(0.5)
+    stats = state.node_stats(raylet_addr)
+    assert not stats.get("bundles"), stats
+    assert (stats["resources_available"].get("CPU")
+            == stats["resources_total"].get("CPU")), stats

@@ -196,3 +196,48 @@ def test_flight_recorder_overhead():
         import ray_tpu
 
         ray_tpu.shutdown()
+
+
+# Round-14 control plane at scale (ISSUE 14): lease grants/s and
+# placement-group 2PC creations/s against a real GcsServer with 100
+# in-process simulated raylets — no cluster processes, so the numbers
+# isolate control-plane code from fork/exec noise. Calibration (same
+# box, 2026-08, 3 fresh runs): lease grants 9.2-13.2k/s; placements
+# 7.4-24.7/s (the spread is real 2PC contention — concurrent groups
+# racing the same most-available nodes pay prepare-reject + backoff
+# rounds). Floors at well under the lowest fresh observation: a
+# per-message regression on the GCS dispatch path (~2x) or a 2PC that
+# starts serializing on artificial barriers trips them through
+# fold-best. The structural zero — no leaked reservations after
+# create+remove churn — is the sharp edge.
+SIM_FLOOR_LEASE_GRANTS_PER_S = 4000.0
+SIM_FLOOR_PLACEMENTS_PER_S = 4.0
+
+
+def test_simcluster_control_plane_floor():
+    from ray_tpu.perf import run_simcluster_bench
+
+    best = {}
+    for _ in range(ROUNDS):
+        r = run_simcluster_bench(n_nodes=100, scale=0.5)
+        assert r["sim_leaked_reservations"] == 0, r
+        if not best:
+            best = r
+        else:
+            best = {
+                **best,
+                "lease_grants_per_s": max(best["lease_grants_per_s"],
+                                          r["lease_grants_per_s"]),
+                "placements_per_s": max(best["placements_per_s"],
+                                        r["placements_per_s"]),
+            }
+        if (best["lease_grants_per_s"] >= SIM_FLOOR_LEASE_GRANTS_PER_S
+                and best["placements_per_s"]
+                >= SIM_FLOOR_PLACEMENTS_PER_S):
+            break
+    assert best["lease_grants_per_s"] >= SIM_FLOOR_LEASE_GRANTS_PER_S, (
+        f"simcluster lease-grant floor violated: {best}\n"
+        "attribute with: python -m ray_tpu.perf --simcluster")
+    assert best["placements_per_s"] >= SIM_FLOOR_PLACEMENTS_PER_S, (
+        f"simcluster placement floor violated: {best}\n"
+        "attribute with: python -m ray_tpu.perf --simcluster")
