@@ -74,7 +74,9 @@ class CompiledDAG:
                  input_channels: List[Channel],
                  output_plan: List[int], output_channels: List[Channel],
                  error_channel: Channel, max_in_flight: int,
-                 multi_output: bool, max_buffered_results: int = 1000):
+                 multi_output: bool, max_buffered_results: int = 1000,
+                 rebuild: Optional[dict] = None,
+                 restart_budget: int = 0):
         self.graph_id = graph_id
         self._actors = actors
         self._input_channels = input_channels
@@ -92,6 +94,17 @@ class CompiledDAG:
         self._broken: Optional[BaseException] = None
         self._torn = False
         self._lock = threading.RLock()
+        # Restart-through-actor-death (round 15): the compile recipe
+        # (DAG root + knobs) so a poisoned graph can recompile onto
+        # restarted actors, and the remaining restart allowance
+        # (min over actors' max_task_retries at compile time — the
+        # same budget that lets the actor plane revive the workers).
+        self._rebuild = rebuild
+        self._restarts_left = max(0, int(restart_budget))
+        # Executions in flight at a restart (never completed): list of
+        # (lo, hi, error) — lo <= index < hi surfaces that epoch's
+        # actor-death error at get().
+        self._failed_epochs: List[Tuple[int, int, BaseException]] = []
 
     # -- execution -------------------------------------------------------
     def execute(self, input_value: Any = None, *,
@@ -100,11 +113,23 @@ class CompiledDAG:
         `max_in_flight` executions are UNDRAINED (backpressure against
         the pipeline); completed-but-never-retrieved results buffer up
         to `max_buffered_results`, past which execute() raises — drop
-        the refs or get() them, they are not free."""
+        the refs or get() them, they are not free.
+
+        A graph poisoned by an actor death attempts a RESTART here
+        (recompile onto the restarted replacement, bounded by the
+        actors' max_task_retries): in-flight executions still fail with
+        the death error, this and later executes flow on the revived
+        graph."""
         with self._lock:
+            if self._broken is not None and not self._torn:
+                self._try_restart()
             self._check_usable()
             while self._submitted - self._drained >= self._max_in_flight:
                 self._drain_next(timeout)
+                if self._broken is not None and not self._torn:
+                    # The drain hit an actor death: revive (failing the
+                    # in-flight window) so THIS execute can proceed.
+                    self._try_restart()
                 self._check_usable()
             from ray_tpu.util.tracing import span, tracing_enabled
             index = self._submitted
@@ -140,9 +165,83 @@ class CompiledDAG:
 
     def _poison(self, exc: BaseException) -> None:
         """An actor died mid-graph: every in-flight execution fails with
-        the original error; the graph is unusable until torn down."""
+        the original error; the graph is unusable until torn down — or
+        until execute() revives it through `_try_restart`."""
         if self._broken is None:
             self._broken = exc
+
+    def _try_restart(self) -> None:
+        """Recompile the DAG onto its (restarted) actors and resume.
+        Caller holds the lock and has seen `_broken`. On success the
+        in-flight window [drained, submitted) is recorded as failed
+        with the death error and the graph accepts new executes; on
+        failure (budget spent, flag off, an actor that cannot come
+        back) the original poison re-raises — exactly the pre-round-15
+        terminal behavior."""
+        from ray_tpu.core.config import ray_config
+
+        err = self._broken
+        if (self._rebuild is None or self._restarts_left <= 0
+                or not ray_config().cgraph_restart):
+            raise err
+        self._restarts_left -= 1
+        # Stop surviving loops + close this epoch's channels. The dead
+        # actor's stop rides the actor plane's retry-through-restart
+        # (max_task_retries), which is what revives its worker; a stop
+        # that still fails leaves compile to surface the real verdict.
+        from ray_tpu.cgraph.loop import _stop_loop
+        import ray_tpu
+        stop_refs = []
+        for _aid, handle in self._actors:
+            try:
+                stop_refs.append(handle.__ray_call__.remote(
+                    _stop_loop, self.graph_id))
+            except Exception:  # noqa: BLE001
+                pass
+        for ref in stop_refs:
+            # Submitted first, reaped second: worst-case stop latency
+            # is the slowest actor, not the sum across actors (all of
+            # this runs under the DAG lock).
+            try:
+                ray_tpu.get(ref, timeout=self._rebuild["install_timeout"])
+            except Exception:  # noqa: BLE001
+                pass
+        for ch in (*self._input_channels, *self._output_channels,
+                   self._error_channel):
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            fresh = compile_dag(
+                self._rebuild["node"],
+                max_in_flight=self._max_in_flight,
+                channel_capacity=self._rebuild["channel_capacity"],
+                install_timeout=self._rebuild["install_timeout"])
+        except BaseException as e:
+            self._broken = err
+            raise err from e
+        # Adopt the fresh compilation's plumbing; keep OUR monotonic
+        # execution indexing (old refs stay addressable).
+        self.graph_id = fresh.graph_id
+        self._actors = fresh._actors
+        self._input_channels = fresh._input_channels
+        self._output_channels = fresh._output_channels
+        self._output_plan = fresh._output_plan
+        self._error_channel = fresh._error_channel
+        self._restarts_left = min(self._restarts_left,
+                                  fresh._restarts_left)
+        fresh._torn = True  # the shell must never tear down adopted guts
+        if self._submitted > self._drained:
+            self._failed_epochs.append((self._drained, self._submitted,
+                                        err))
+        self._drained = self._submitted
+        self._broken = None
+        from ray_tpu.core import flight
+        if flight.enabled:
+            flight.instant("cgraph", "cgraph.restart",
+                           arg=f"{self.graph_id[:6]} "
+                               f"left={self._restarts_left}")
 
     def _check_actor_liveness(self) -> bool:
         """Poison the graph when the owner already knows a loop actor is
@@ -219,6 +318,12 @@ class CompiledDAG:
     def _get_result(self, index: int, timeout: Optional[float]) -> Any:
         with self._lock:
             while index not in self._results:
+                for lo, hi, err in self._failed_epochs:
+                    if lo <= index < hi:
+                        # In flight at a restart and never completed:
+                        # that epoch's actor-death error is this ref's
+                        # result.
+                        raise err
                 if self._broken is not None:
                     raise self._broken
                 if self._torn:
@@ -450,6 +555,17 @@ def compile_dag(output_node, *, max_in_flight: int = 8,
         for aid, handle in actor_handle.items()]
     ray_tpu.get(install_refs, timeout=install_timeout)
 
+    # Restart budget: the graph can be revived through actor death as
+    # long as EVERY actor still has task-retry allowance — the same
+    # budget `_submit_actor_async` spends restarting the worker under
+    # the loop-control calls (max_task_retries=-1 counts as unbounded).
+    budgets = []
+    for aid in actor_handle:
+        st = getattr(rt, "_actors", {}).get(aid) if not local_mode else None
+        t = getattr(st, "task_retries", 0) if st is not None else 0
+        budgets.append(1 << 30 if t < 0 else t)
+    restart_budget = min(budgets) if budgets else 0
+
     return CompiledDAG(
         graph_id=graph_id,
         actors=[(aid, h) for aid, h in actor_handle.items()],
@@ -458,4 +574,8 @@ def compile_dag(output_node, *, max_in_flight: int = 8,
         output_channels=output_channels,
         error_channel=error_channel,
         max_in_flight=max_in_flight,
-        multi_output=multi_output)
+        multi_output=multi_output,
+        rebuild={"node": output_node,
+                 "channel_capacity": channel_capacity,
+                 "install_timeout": install_timeout},
+        restart_budget=restart_budget)

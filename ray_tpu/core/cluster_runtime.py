@@ -43,6 +43,8 @@ from ray_tpu.core.wire import (ActorTaskSpec as WireActorTaskSpec,
                                SpecTemplate,
                                TaskSpec as WireTaskSpec, from_wire,
                                from_wire_fast, to_wire)
+from ray_tpu.core import lineage as lineage_mod
+from ray_tpu.core.lineage import LineageTable
 from ray_tpu.core.rpc import (ConnectionLost, EventLoopThread, RpcClient,
                               RpcError, RpcServer, ServerConnection)
 from ray_tpu.util.tracing import (current_traceparent, span,
@@ -122,9 +124,19 @@ async def schedule_placement_group(gcs, raylet_client_for, pg_id: str,
                 if failure is None:
                     for idx, node in prepared:
                         client = await raylet_client_for(node["address"])
-                        await client.call("commit_bundle", pg_id=pg_id,
-                                          bundle_index=idx,
-                                          timeout=10.0)
+                        ok = await client.call("commit_bundle",
+                                               pg_id=pg_id,
+                                               bundle_index=idx,
+                                               timeout=10.0)
+                        if not ok:
+                            # Reservation vanished between prepare and
+                            # commit (raylet restart, concurrent
+                            # return): a CREATED verdict over it would
+                            # be a group nothing can lease against.
+                            failure = (f"commit rejected for bundle "
+                                       f"{idx}")
+                            break
+                if failure is None:
                     # CAS on PENDING, INSIDE the try: a CAS that raises
                     # must reach this attempt's rollback below — an
                     # escaped exception here once leaked every committed
@@ -478,7 +490,10 @@ class ClusterRuntime:
         # Lineage: return-oid -> shared task record, kept while any return
         # ref lives so lost objects can be re-executed (reference:
         # task_manager.h:424 RetryTaskIfPossible + lineage pinning).
-        self._lineage: Dict[str, dict] = {}
+        # Policy (retention gate, budget, inflight dedup) lives in
+        # core/lineage.py so the simcluster harness exercises the same
+        # state machine.
+        self._lineage = LineageTable()
         if mode == "driver":
             import sys
             # sys_path lets workers import driver-local modules (test files,
@@ -581,6 +596,15 @@ class ClusterRuntime:
                 return
         if not addr:
             return
+        # Drop cached placement-group location tables naming the dead
+        # node: the GCS is rescheduling those bundles, and the next
+        # _pg_location refetches (waiting out RESCHEDULING) instead of
+        # leasing against a dead address forever.
+        for pg_id, info in list(self._pg_cache.items()):
+            if any(loc.get("address") == addr or loc.get("node_id")
+                   == node_id
+                   for loc in info.get("bundle_locations") or []):
+                self._pg_cache.pop(pg_id, None)
         lost = []
         with self._owned_lock:
             for oid, entry in self._owned.items():
@@ -819,14 +843,11 @@ class ClusterRuntime:
         for child in self._shard_children.pop(oid, ()):
             # Shard objects live exactly as long as their manifest.
             self.remove_local_reference(ObjectID(bytes.fromhex(child)))
-        rec = self._lineage.pop(oid, None)
-        if rec is not None:
-            rec["live"] -= 1
-            if rec["live"] <= 0:
-                # Last return ref gone: lineage no longer needs the task's
-                # argument objects pinned.
-                self._unpin_args(rec["pinned"])
-                rec["pinned"] = []
+        lineage_pins = self._lineage.release(oid)
+        if lineage_pins:
+            # Last return ref gone: lineage no longer needs the task's
+            # argument objects pinned.
+            self._unpin_args(lineage_pins)
         if nodes:
             async def _delete():
                 for addr in nodes:
@@ -1474,18 +1495,17 @@ class ClusterRuntime:
         self._record_task_event(task_id.hex(),
                                 remote_function._function_name,
                                 "SUBMITTED")
-        retain = (not streaming and opts.num_returns != 0
-                  and opts.max_retries > 0)
-        if retain:
+        rec = None
+        if not streaming and opts.num_returns != 0 and opts.max_retries > 0:
             # Retain the spec (and keep its arg refs pinned) for lineage
-            # re-execution; released when the last return ref is freed.
-            rec = {"spec": spec, "ref_oids": [r.hex() for r in refs],
-                   "pinned": pinned, "left": max(opts.max_retries, 0),
-                   "live": len(refs), "inflight": False}
-            for r in refs:
-                self._lineage[r.hex()] = rec
+            # re-execution; released when the last return ref is freed —
+            # or early, when the reply shows every result landed inline
+            # (owner-future values cannot be lost). None when the
+            # lineage_reconstruction flag is off.
+            rec = self._lineage.retain([r.hex() for r in refs], spec,
+                                       pinned, opts.max_retries)
         self._enqueue_submit(
-            ("task", spec, refs, pinned if not retain else None,
+            ("task", spec, refs, pinned if rec is None else None,
              sched_key, tmpl))
         if streaming:
             return gen
@@ -1618,7 +1638,9 @@ class ClusterRuntime:
         # retain branch. Purely-inline results live in the owner future
         # and cannot be lost, so they skip the bookkeeping.
         stored = any(r.get("node") for r in reply.get("results", ()))
-        if stored and opts.max_retries > 0 and num_returns != 0:
+        rec = None
+        if (stored and opts.max_retries > 0 and num_returns != 0
+                and self._lineage.enabled()):
             wire_spec, _, _ = self._encode_task_spec(
                 remote_function, opts, fn_key, num_returns, False,
                 task_id=task_id.hex(), args=args_blob,
@@ -1626,13 +1648,9 @@ class ClusterRuntime:
                           list(args) + list(kwargs.values())
                           if isinstance(a, ObjectRef)],
                 trace_ctx=trace_ctx)
-            rec = {"spec": wire_spec,
-                   "ref_oids": [r.hex() for r in refs],
-                   "pinned": pinned, "left": max(opts.max_retries, 0),
-                   "live": len(refs), "inflight": False}
-            for r in refs:
-                self._lineage[r.hex()] = rec
-        else:
+            rec = self._lineage.retain([r.hex() for r in refs], wire_spec,
+                                       pinned, opts.max_retries)
+        if rec is None:
             self._unpin_args(pinned)
         if num_returns == 0:
             return None
@@ -2404,7 +2422,8 @@ class ClusterRuntime:
                          [(r.get("oid", "")[:16], r.get("node"),
                            ("inline" if r.get("inline") is not None
                             else "-")) for r in reply.get("results", [])])
-        for res in reply.get("results", []):
+        results = reply.get("results", [])
+        for res in results:
             entry = self._owned_entry(res["oid"])
             if res.get("node"):
                 if res["node"] not in entry.nodes:
@@ -2415,6 +2434,14 @@ class ClusterRuntime:
             else:
                 if not entry.fut.done():
                     entry.fut.set_result(("inline", res["inline"]))
+        if results and not any(res.get("node") for res in results):
+            # Every result landed inline: the owner futures hold the
+            # values and nothing is ever losable — release the lineage
+            # record (and its arg pins) now instead of carrying the spec
+            # until the refs die. Retention is for STORE-SEALED results.
+            rec = self._lineage.get(results[0]["oid"])
+            if rec is not None:
+                self._unpin_args(self._lineage.drop_record(rec))
         if spec.get("streaming") and reply.get("done"):
             gen = self._generators.pop(task_id, None)
             if gen is not None:
@@ -3542,16 +3569,18 @@ class ClusterRuntime:
 
     def _trigger_reconstruction(self, oid: str) -> bool:
         """Re-execute the task that produced `oid` (owner-side; runs on the
-        RPC loop). Pullers observing `pending` keep waiting meanwhile."""
-        rec = self._lineage.get(oid)
-        if rec is None or rec["inflight"]:
-            return rec is not None and rec["inflight"]
-        if rec["left"] <= 0:
-            logger.warning("object %s lost and reconstruction budget "
-                           "exhausted", oid[:16])
+        RPC loop). Pullers observing `pending` keep waiting meanwhile.
+        Returns True when a re-execution is running (started now or
+        already inflight); False means the loss is final (unretained
+        lineage or exhausted budget) and the typed error stands."""
+        verdict, rec = self._lineage.begin_reexec(oid)
+        if verdict == lineage_mod.INFLIGHT:
+            return True
+        if verdict != lineage_mod.STARTED:
+            if verdict == lineage_mod.EXHAUSTED:
+                logger.warning("object %s lost and reconstruction budget "
+                               "exhausted", oid[:16])
             return False
-        rec["inflight"] = True
-        rec["left"] -= 1
         refs = []
         with self._owned_lock:
             for roid in rec["ref_oids"]:
@@ -3579,7 +3608,7 @@ class ClusterRuntime:
                                "%r", oid[:16], e)
                 raise
             finally:
-                rec["inflight"] = False
+                self._lineage.end_reexec(rec)
                 if logger.isEnabledFor(logging.DEBUG):
                     with self._owned_lock:
                         e = self._owned.get(oid)
@@ -3592,6 +3621,32 @@ class ClusterRuntime:
 
         self._loop.spawn(_resubmit())
         return True
+
+    async def handle_reconstruct_object(self, conn: ServerConnection, *,
+                                        oid: str) -> Dict[str, Any]:
+        """A raylet's pull found no reachable copy of an object we own:
+        decide recovery. `recovering=True` tells the puller to keep
+        polling (a value is pending, copies reappeared, or a lineage
+        re-execution just started); False means the loss is final and
+        the borrower's get must fail with the typed error. This closes
+        the notify race where a prune was still in flight when the
+        puller's next locations query saw an empty directory."""
+        with self._owned_lock:
+            entry = self._owned.get(oid)
+            if entry is None:
+                return {"recovering": False, "known": False}
+            if not entry.fut.done():
+                return {"recovering": True}
+            if entry.nodes:
+                # Copies (re)appeared since the puller looked — or the
+                # puller's view raced a fresh seal. Re-resolve.
+                return {"recovering": True}
+            kind, _ = entry.fut.result()
+            if kind == "inline":
+                # Inline values live in the owner future; the next
+                # locations query returns the payload itself.
+                return {"recovering": True}
+        return {"recovering": self._trigger_reconstruction(oid)}
 
     async def handle_ping(self, conn: ServerConnection) -> str:
         return "pong"

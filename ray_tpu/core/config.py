@@ -127,6 +127,15 @@ _D("pg_stuck_commit_s", 60.0,
    "within this window is returned by the reconciler (owner died "
    "between commit and the CREATED CAS).")
 _D("raylet_heartbeat_period_ms", 250, "Raylet->GCS resource report interval.")
+_D("cluster_view_refresh_ms", 1000,
+   "How often a raylet refreshes its full cluster view (the node table "
+   "feeding spillback targeting and dead-address checks), decoupled "
+   "from the heartbeat. Round-15 1000-node profiling found the "
+   "per-heartbeat get_nodes() fetch is the GCS dispatch wall at scale: "
+   "N nodes × (1/period) full-table replies per second is O(N^2) "
+   "records/s — at N=1000 that alone saturated the sim's GCS loop. "
+   "Liveness still rides every heartbeat; the view tolerates seconds "
+   "of staleness (the retry/spillback discipline re-resolves).")
 _D("actor_restart_backoff_ms", 1000, "Backoff between actor restarts.")
 _D("metrics_report_interval_ms", 2000, "Metrics agent scrape/export interval.")
 _D("task_events_flush_interval_ms", 1000,
@@ -152,6 +161,28 @@ _D("object_spill_dir", "",
    "Spill directory; empty = /tmp/ray_tpu_spill_<node_id>.")
 _D("memory_monitor_refresh_ms", 250, "OOM monitor interval; 0 disables.")
 _D("memory_usage_threshold", 0.95, "Node memory fraction that triggers the OOM killer.")
+_D("lineage_reconstruction", True,
+   "Owner-side lineage reconstruction of lost objects (round 15): the "
+   "owner retains the wire-encoded spec of any task whose result was "
+   "store-sealed (and pins the task's argument objects) while a return "
+   "ref lives, and re-executes it through the normal dispatch tiers "
+   "when the last copy is lost (holder node died, evicted everywhere). "
+   "Borrowers' in-flight gets block-and-retry through the re-execution "
+   "instead of failing. Disabling restores the pre-round-15 behavior: "
+   "loss surfaces immediately as the typed ObjectLostError "
+   "(reference: task_manager.h lineage pinning + "
+   "object_recovery_manager.h).")
+_D("lineage_reconstruction_budget", 8,
+   "Hard cap on per-object re-executions, regardless of max_retries: "
+   "a flapping node must not re-run a task unboundedly. Exhausting "
+   "the budget degrades the next loss to ObjectLostError.")
+_D("cgraph_restart", True,
+   "Compiled-graph recovery (round 15): when a loop actor of a "
+   "compiled DAG dies, recompile its schedule onto the restarted "
+   "replacement (bounded by the actors' max_task_retries budget) and "
+   "resume — in-flight executions still fail with the actor-death "
+   "error, but the graph accepts new executes instead of staying "
+   "poisoned until teardown. Disabling restores permanent poisoning.")
 _D("borrow_escrow_s", 600.0,
    "How long a result-embedded ref stays escrow-pinned in its owner "
    "process, bridging the gap between shipping a result and the "

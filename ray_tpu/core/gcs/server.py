@@ -65,6 +65,17 @@ class GcsServer:
         # the restarted server before we may judge them (set by
         # _load_storage when it recovers alive nodes).
         self._restart_grace_until = 0.0
+        # GCS-led placement-group rescheduling (round 15): pg_id -> the
+        # asyncio task re-placing its lost bundles. Spawned by
+        # _mark_node_dead, resumed at start() for groups recovered
+        # mid-RESCHEDULING, re-kicked by the health loop when a stuck
+        # group's cluster changes.
+        self._reschedule_tasks: Dict[str, asyncio.Task] = {}
+        # Outbound raylet clients for the reschedule 2PC. The simcluster
+        # harness overrides `raylet_client_factory` to route through its
+        # fault-injected dispatch; production dials RpcClients.
+        self.raylet_client_factory = None
+        self._raylet_clients: Dict[str, Any] = {}
 
     @property
     def address(self) -> str:
@@ -99,6 +110,11 @@ class GcsServer:
         if self._storage_path:
             self._snapshot_task = asyncio.ensure_future(
                 self._snapshot_loop())
+        # Crash-resume: a kill -9 mid-reschedule leaves groups
+        # RESCHEDULING (the transition was written through); a crash
+        # BEFORE the transition leaves a CREATED group pointing at a
+        # node recovered as dead. Both resume here.
+        await self._rescan_reschedules()
         if serve_rpc:
             logger.info("GCS listening on %s", self.address)
 
@@ -395,6 +411,15 @@ class GcsServer:
             self._health_task.cancel()
         if self._snapshot_task:
             self._snapshot_task.cancel()
+        for task in self._reschedule_tasks.values():
+            task.cancel()
+        self._reschedule_tasks.clear()
+        for client in self._raylet_clients.values():
+            try:
+                await client.close()
+            except Exception:
+                pass
+        self._raylet_clients.clear()
         if self._storage_path and self._dirty:
             # Final flush: acked mutations survive a clean shutdown
             # (through the same lock as every other writer).
@@ -430,6 +455,9 @@ class GcsServer:
                     logger.warning("node %s missed heartbeats; marking dead",
                                    node_id[:8])
                     await self._mark_node_dead(node_id)
+            # Re-kick stuck reschedules + the mid-pass-race safety net
+            # (one shared scan; see _rescan_reschedules).
+            await self._rescan_reschedules()
 
     async def _mark_node_dead(self, node_id: str) -> None:
         info = self.nodes.get(node_id)
@@ -452,6 +480,118 @@ class GcsServer:
                 a["state"] = "DEAD"
                 a["death_cause"] = "node_died"
                 await self._publish(f"actor:{actor_id}", a)
+        # GCS-led PG rescheduling (round 15): a CREATED group with a
+        # bundle on the dead node goes RESCHEDULING (write-through CAS)
+        # and a recovery pass re-places only the lost bundles onto
+        # survivors. Owner-led recovery is impossible here — the owner
+        # may have died WITH the node. Same scan the health loop runs.
+        await self._rescan_reschedules()
+
+    # ------------------------------------------------------------------
+    # GCS-led placement-group rescheduling (round 15; reference:
+    # GcsPlacementGroupScheduler rescheduling on node removal)
+    # ------------------------------------------------------------------
+    async def _rescan_reschedules(self) -> None:
+        """The one reschedule scan (start() crash-resume, health loop):
+        RESCHEDULING groups get a live pass (stuck ones re-kick each
+        period — new node registrations make yesterday's infeasible
+        placement feasible), and CREATED groups naming a non-alive
+        node re-begin. The CREATED check is the SAFETY NET for the
+        mid-pass race: a node that dies while its group is already
+        RESCHEDULING is skipped by _mark_node_dead's CREATED-only
+        trigger, so the pass can land CREATED with a location table
+        naming the fresh corpse — this scan heals it."""
+        for pg_id, pg in list(self.placement_groups.items()):
+            state = pg.get("state")
+            if state == "RESCHEDULING":
+                self._spawn_reschedule(pg_id)
+            elif state == "CREATED" and any(
+                    not (self.nodes.get(loc.get("node_id")) or {})
+                    .get("alive", False)
+                    for loc in pg.get("bundle_locations") or []):
+                await self._begin_reschedule(pg_id)
+
+    async def _begin_reschedule(self, pg_id: str) -> None:
+        """CAS a CREATED group to RESCHEDULING (write-through: the
+        raylet reconciler must see the group still stands behind its
+        surviving bundles across a GCS crash) and spawn the recovery
+        pass."""
+        ok = await self.handle_update_placement_group(
+            None, pg_id=pg_id, updates={"state": "RESCHEDULING"},
+            expect_state="CREATED")
+        if ok:
+            self._spawn_reschedule(pg_id)
+
+    def _spawn_reschedule(self, pg_id: str) -> None:
+        task = self._reschedule_tasks.get(pg_id)
+        if task is not None and not task.done():
+            return
+        task = asyncio.ensure_future(self._reschedule_pg(pg_id))
+        self._reschedule_tasks[pg_id] = task
+        # Self-pruning: a finished pass must not pin its Task (frame,
+        # locals) for the life of the process under PG churn.
+        task.add_done_callback(
+            lambda t, pg_id=pg_id: (
+                self._reschedule_tasks.pop(pg_id, None)
+                if self._reschedule_tasks.get(pg_id) is t else None))
+
+    async def _reschedule_pg(self, pg_id: str) -> None:
+        from ray_tpu.core.pg_scheduler import reschedule_placement_group
+
+        try:
+            state = await reschedule_placement_group(
+                self._local_accessor(), self._raylet_client_for, pg_id)
+            if state == "RESCHEDULING":
+                logger.warning(
+                    "placement group %s still RESCHEDULING after every "
+                    "attempt (no feasible placement); the health loop "
+                    "re-kicks when the cluster changes", pg_id[:8])
+        except Exception:
+            logger.warning("pg %s reschedule pass crashed", pg_id[:8],
+                           exc_info=True)
+
+    def _local_accessor(self) -> Any:
+        """What `reschedule_placement_group` needs from 'the GCS' — the
+        same three accessors the owner-side 2PC uses, served from our
+        own tables so the protocol definition stays shared."""
+        server = self
+
+        class _Accessor:
+            async def get_placement_group(self, pg_id):
+                return server.placement_groups.get(pg_id)
+
+            async def get_nodes(self):
+                return list(server.nodes.values())
+
+            async def update_placement_group(self, pg_id, updates,
+                                             expect_state=None):
+                return await server.handle_update_placement_group(
+                    None, pg_id=pg_id, updates=updates,
+                    expect_state=expect_state)
+
+        return _Accessor()
+
+    async def _raylet_client_for(self, address: str) -> Any:
+        """Outbound raylet client for the reschedule 2PC. The sim
+        harness injects `raylet_client_factory` to route through its
+        fault plan; production dials (and caches) a real RpcClient."""
+        if self.raylet_client_factory is not None:
+            return self.raylet_client_factory(address)
+        from ray_tpu.core.rpc import RpcClient
+
+        client = self._raylet_clients.get(address)
+        if client is None or not client.connected:
+            if client is not None:
+                # Replace-without-close leaks the dead client's
+                # transport on every raylet flap.
+                try:
+                    await client.close()
+                except Exception:
+                    pass
+            client = RpcClient(address)
+            await client.connect(timeout=5.0)
+            self._raylet_clients[address] = client
+        return client
 
     # ------------------------------------------------------------------
     # pubsub
@@ -775,12 +915,16 @@ class GcsServer:
         info.update(updates)
         self.mark_dirty("placement_groups", pg_id)
         await self._publish(f"pg:{pg_id}", info)
-        if updates.get("state") in ("CREATED", "REMOVED", "INFEASIBLE"):
+        if updates.get("state") in ("CREATED", "REMOVED", "INFEASIBLE",
+                                    "RESCHEDULING"):
             # Terminal transitions are registration-class (see
             # flush_now docstring): an acked CREATED that a kill -9
             # forgets would leave committed bundles pointing at a
             # PENDING ghost after restart — exactly the half-reserved
-            # state the chaos test forbids.
+            # state the chaos test forbids. RESCHEDULING writes through
+            # too: the recovery pass must resume (not vanish) across a
+            # GCS crash, and the raylet reconciler must keep standing
+            # behind the surviving bundles it reads this state for.
             await self.flush_now()
         return True
 

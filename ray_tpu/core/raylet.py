@@ -238,6 +238,49 @@ class NodeLedger:
                 return  # control plane unreachable: judge nothing
             state = (info or {}).get("state")
             if state == "CREATED":
+                # The group stands — but only behind the bundles its
+                # location table names. A commit that landed here during
+                # a crashed GCS reschedule pass whose final CAS chose a
+                # DIFFERENT node is an orphan reservation: nothing will
+                # ever lease or return it.
+                locs = (info or {}).get("bundle_locations") or []
+                for key, b in list(self._bundles.items()):
+                    if (not key.startswith(pg_id + ":") or not b.committed
+                            or b.removed):
+                        continue
+                    try:
+                        idx = int(key.rsplit(":", 1)[1])
+                    except ValueError:
+                        continue
+                    if (idx < len(locs)
+                            and locs[idx].get("node_id") != self.node_id
+                            and now - getattr(b, "committed_at", now)
+                            >= cfg.pg_stuck_commit_s):
+                        # The commit-age grace mirrors the PENDING
+                        # branch: a FRESH mislocated commit is most
+                        # likely an in-flight reschedule pass that
+                        # prepared+committed here while our CREATED
+                        # read was already in flight (stale snapshot)
+                        # — returning it would strand the location
+                        # table the pass is about to write. A genuine
+                        # crash orphan persists past the window and
+                        # still comes back.
+                        logger.warning(
+                            "returning bundle %s committed here but "
+                            "located on %s (rescheduled elsewhere)",
+                            key, locs[idx].get("node_id"))
+                        from ray_tpu.core import flight
+
+                        if flight.enabled:
+                            flight.instant("pg", "pg.rollback", arg=key)
+                        self._return_bundle(key)
+                continue
+            if state == "RESCHEDULING":
+                # A member node died and the GCS is re-placing the LOST
+                # bundles; surviving reservations (ours) must hold — a
+                # rollback here would be the capacity the group still
+                # legitimately owns. The rescheduler's terminal CAS
+                # (back to CREATED) re-enables the location check above.
                 continue
             if state == "PENDING":
                 if any(now - getattr(b, "committed_at", now)
@@ -424,6 +467,21 @@ class Raylet(NodeLedger):
         # that dies (not merely times out) can never use or return its
         # grants, so disconnect reclaims them.
         self._lease_conns: Dict[str, tuple] = {}
+        # At-least-once protection for the lease plane (round 15 chaos):
+        # a duplicated/retried request_worker_lease(s) must be served
+        # the ORIGINAL grant reply, never a second worker. Grant replies
+        # cache by request_id (spillback/error replies are not cached —
+        # re-deciding them acquires nothing and a cached spillback
+        # could pin a client to a dead verdict forever); concurrent
+        # duplicates share the in-flight future.
+        self._lease_reply_cache: Dict[str, Dict[str, Any]] = {}
+        self._lease_inflight: Dict[str, asyncio.Future] = {}
+        # request_ids the client cancelled: a cancel can land BETWEEN
+        # the grant (recorded in _recent_grants, future resolved) and
+        # the handler coroutine resuming to cache its reply — caching
+        # then would serve a later duplicate a grant whose workers the
+        # cancel already reclaimed (and possibly re-leased).
+        self._cancelled_lease_requests: Dict[str, None] = {}
         self._stopping = False
         # worker_id -> why the raylet killed it ("oom"); lets the task
         # submitter surface a typed retriable OutOfMemoryError instead of
@@ -539,6 +597,7 @@ class Raylet(NodeLedger):
 
     async def _heartbeat_loop(self) -> None:
         period = ray_config().raylet_heartbeat_period_ms / 1000.0
+        last_view = 0.0
         while True:
             try:
                 ok = await self._gcs.heartbeat(
@@ -556,8 +615,19 @@ class Raylet(NodeLedger):
                     logger.info("GCS does not recognize this node; "
                                 "re-registering")
                     await self._register_with_gcs()
-                self._cluster_view = {
-                    n["node_id"]: n for n in await self._gcs.get_nodes()}
+                # Cluster-view refresh is throttled SEPARATELY from the
+                # liveness heartbeat: fetching the full node table per
+                # beat is O(N^2) records/s across the fleet and was the
+                # GCS dispatch wall at 1000 simulated nodes (PROFILE
+                # round 11). Spillback/dead-address consumers tolerate
+                # a stale view — their retry discipline re-resolves.
+                now = time.monotonic()
+                if (now - last_view
+                        >= ray_config().cluster_view_refresh_ms / 1000.0):
+                    self._cluster_view = {
+                        n["node_id"]: n
+                        for n in await self._gcs.get_nodes()}
+                    last_view = now
             except Exception:
                 logger.warning("heartbeat to GCS failed", exc_info=True)
             self._reap_stale_prepares()
@@ -924,6 +994,60 @@ class Raylet(NodeLedger):
             is_actor, spillback_count = lr.is_actor, lr.spillback_count
             bundle, request_id = lr.bundle, lr.request_id
             job_id = lr.job_id
+        return await self._deduped_lease_reply(
+            request_id,
+            lambda: self._lease_single(
+                conn, resources=resources, scheduling_key=scheduling_key,
+                is_actor=is_actor, spillback_count=spillback_count,
+                bundle=bundle, request_id=request_id, job_id=job_id))
+
+    async def _deduped_lease_reply(self, request_id: Optional[str],
+                                   factory) -> Dict[str, Any]:
+        """At-least-once lease dispatch: a duplicate delivery (network
+        retry, fault-injected redelivery) of a request_id whose grant
+        already happened gets the CACHED reply; one racing the original
+        awaits the same in-flight future. Without this, each duplicate
+        of a batched lease request grants a fresh worker set that no
+        client will ever use or return."""
+        if not request_id:
+            return await factory()
+        cached = self._lease_reply_cache.get(request_id)
+        if cached is not None:
+            return cached
+        inflight = self._lease_inflight.get(request_id)
+        if inflight is not None:
+            return await asyncio.shield(inflight)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._lease_inflight[request_id] = fut
+        try:
+            reply = await factory()
+            if ((reply.get("granted") or reply.get("grants"))
+                    and request_id not in self._cancelled_lease_requests):
+                self._lease_reply_cache[request_id] = reply
+                while len(self._lease_reply_cache) > 512:
+                    self._lease_reply_cache.pop(
+                        next(iter(self._lease_reply_cache)))
+            if not fut.done():
+                fut.set_result(reply)
+            return reply
+        except BaseException as e:
+            if not fut.done():
+                fut.set_exception(e)
+                # A shielded duplicate may never retrieve it.
+                try:
+                    fut.exception()
+                except Exception:
+                    pass
+            raise
+        finally:
+            self._lease_inflight.pop(request_id, None)
+
+    async def _lease_single(
+            self, conn: ServerConnection, *,
+            resources: Dict[str, float], scheduling_key: str,
+            is_actor: bool, spillback_count: int,
+            bundle: Optional[List[Any]], request_id: Optional[str],
+            job_id: Optional[str]) -> Dict[str, Any]:
         demand = {k: float(v) for k, v in resources.items() if v}
         if logger.isEnabledFor(logging.DEBUG):
             logger.debug(
@@ -985,6 +1109,11 @@ class Raylet(NodeLedger):
         from ray_tpu.core.wire import from_wire
 
         lr = from_wire(req, expect="LeaseRequest")
+        return await self._deduped_lease_reply(
+            lr.request_id, lambda: self._lease_batch(conn, lr))
+
+    async def _lease_batch(self, conn: ServerConnection,
+                           lr) -> Dict[str, Any]:
         count = max(1, int(lr.get("count") or 1))
         demand = {k: float(v) for k, v in lr.resources.items() if v}
         # Hybrid-policy parity with the single-lease path: a node past
@@ -1022,7 +1151,9 @@ class Raylet(NodeLedger):
                 if not self._can_start_worker(for_actor=lr.is_actor):
                     break
                 self._spawn_worker()
-        return await self.handle_request_worker_lease(
+        # Degrade to single-lease semantics — straight to the inner
+        # path: this call is already inside the batch's dedup scope.
+        return await self._lease_single(
             conn, resources=lr.resources,
             scheduling_key=lr.scheduling_key, is_actor=lr.is_actor,
             spillback_count=lr.spillback_count, bundle=lr.bundle,
@@ -1320,6 +1451,15 @@ class Raylet(NodeLedger):
         """A client gave up on a lease (timeout): drop it from the queue,
         or — if it was granted in the meantime — return the worker so the
         abandoned grant doesn't leak its resources."""
+        # A duplicate delivery arriving after the cancel must not be
+        # served the cached (now-reclaimed) grants — and a grant whose
+        # handler has not yet RESUMED to cache its reply must find the
+        # cancellation when it does (the cache-then-cancel race).
+        self._lease_reply_cache.pop(request_id, None)
+        self._cancelled_lease_requests[request_id] = None
+        while len(self._cancelled_lease_requests) > 512:
+            self._cancelled_lease_requests.pop(
+                next(iter(self._cancelled_lease_requests)))
         for pending in self._pending:
             if pending.request_id == request_id:
                 self._pending.remove(pending)
@@ -1740,8 +1880,29 @@ class Raylet(NodeLedger):
                     except Exception:
                         pass
                 if not loc.get("pending") and not loc.get("nodes"):
-                    # No copies AND the owner is not producing one (no
-                    # in-flight task, no reconstruction): permanently lost.
+                    # No copies and the owner is not currently producing
+                    # one. Ask the owner to RECOVER it (lineage
+                    # re-execution) before declaring the loss final —
+                    # relying on the prune notify alone races this
+                    # loop's next locations query against the owner's
+                    # reconstruction trigger and failed borrower gets
+                    # that lineage could have saved. `recovering=False`
+                    # is authoritative: unretained lineage or exhausted
+                    # budget, the typed loss stands.
+                    try:
+                        r = await owner.call("reconstruct_object",
+                                             oid=oid, timeout=10.0)
+                    except Exception:
+                        # Transient owner blip: re-enter the loop; the
+                        # owner-unreachable grace above judges real
+                        # owner death.
+                        await asyncio.sleep(
+                            ray_config().object_timeout_ms / 1000.0)
+                        continue
+                    if r and r.get("recovering"):
+                        await asyncio.sleep(
+                            ray_config().object_timeout_ms / 1000.0)
+                        continue
                     return {"error": "no reachable copy"}
             await asyncio.sleep(ray_config().object_timeout_ms / 1000.0)
         return {"error": "timeout"}

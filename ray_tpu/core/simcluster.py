@@ -34,14 +34,18 @@ import random
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.core import lineage as lineage_mod
 from ray_tpu.core.cluster_runtime import schedule_placement_group
 from ray_tpu.core.config import ray_config
 from ray_tpu.core.faults import FaultPlan
 from ray_tpu.core.gcs.client import GcsClient, backoff_delay
 from ray_tpu.core.gcs.server import GcsServer
+from ray_tpu.core.lineage import LineageTable
 from ray_tpu.core.raylet import NodeLedger, _Bundle  # noqa: F401 (re-export)
 from ray_tpu.core.rpc import ConnectionLost
 from ray_tpu.core.rpc_testing import LoopbackClient
+from ray_tpu.exceptions import (GetTimeoutError, ObjectLostError,
+                                OwnerDiedError)
 
 logger = logging.getLogger(__name__)
 
@@ -57,6 +61,8 @@ SIM_CONFIG = {
     "gcs_reconnect_backoff_max_ms": 250.0,
     "pg_reconcile_interval_s": 0.25,
     "pg_stuck_commit_s": 2.0,
+    "object_timeout_ms": 20,
+    "cluster_view_refresh_ms": 100,
 }
 
 
@@ -147,6 +153,11 @@ class SimRaylet(NodeLedger):
         self._chips_free: List[int] = list(
             range(int(resources.get("TPU", 0))))
         self._cluster_view: Dict[str, Dict[str, Any]] = {}
+        # Simulated object store: oid -> value. One dict stands in for
+        # the plasma store; the PROTOCOL around it (owner location
+        # directory, holder-death pruning, reconstruct-or-fail) mirrors
+        # raylet.handle_pull_object step for step.
+        self._objects: Dict[str, Any] = {}
         self.alive = True
         self.registered = False
         self.lease_grants = 0
@@ -178,6 +189,7 @@ class SimRaylet(NodeLedger):
         outages back off with the shared jittered delay."""
         period = ray_config().raylet_heartbeat_period_ms / 1000.0
         attempt = 0
+        last_view = 0.0
         while self.alive:
             try:
                 ok = await self._gcs.heartbeat(
@@ -185,8 +197,17 @@ class SimRaylet(NodeLedger):
                     load={"pending": 0})
                 if ok is False:
                     await self._register_with_gcs()
-                self._cluster_view = {
-                    n["node_id"]: n for n in await self._gcs.get_nodes()}
+                # View refresh throttled separately from liveness —
+                # the same contract as the real raylet (PROFILE round
+                # 11: per-beat get_nodes was the 1000-node GCS wall).
+                now = time.monotonic()
+                if (now - last_view
+                        >= ray_config().cluster_view_refresh_ms
+                        / 1000.0):
+                    self._cluster_view = {
+                        n["node_id"]: n
+                        for n in await self._gcs.get_nodes()}
+                    last_view = now
                 attempt = 0
             except Exception:
                 await asyncio.sleep(backoff_delay(attempt))
@@ -291,7 +312,102 @@ class SimRaylet(NodeLedger):
                             "committed": b.committed}
                         for k, b in self._bundles.items() if not b.removed},
             "leases": len(self._leases),
+            "objects": len(self._objects),
         }
+
+    # -- simulated object plane (round 15: data-plane recovery) ---------
+    async def handle_store_sim_object(self, conn, *, oid: str,
+                                      value: Any) -> bool:
+        self._objects[oid] = value
+        return True
+
+    async def handle_read_sim_object(self, conn, *,
+                                     oid: str) -> Dict[str, Any]:
+        """Remote holder read (the sim's read_object): found=False means
+        'no longer a holder' and the puller prunes this location."""
+        if oid in self._objects:
+            return {"found": True, "value": self._objects[oid]}
+        return {"found": False}
+
+    async def handle_pull_sim_object(self, conn, *, oid: str, owner: str,
+                                     pull_timeout: float = 15.0
+                                     ) -> Dict[str, Any]:
+        """The borrower-side pull loop — raylet.handle_pull_object's
+        protocol over the sim message plane: local store -> owner's
+        location directory -> holder fetch; a dead holder is pruned at
+        the owner; empty-directory-and-not-pending asks the owner to
+        RECONSTRUCT (lineage re-execution) and keeps polling while it
+        recovers; only an authoritative 'no recovery' fails the get."""
+        cfg = ray_config()
+        poll = cfg.object_timeout_ms / 1000.0
+        deadline = time.monotonic() + pull_timeout
+        owner_unreachable_since: Optional[float] = None
+        while time.monotonic() < deadline:
+            if oid in self._objects:
+                return {"value": self._objects[oid]}
+            try:
+                loc = await self.cluster.dispatch(
+                    self.node_id, owner, "get_sim_object_locations",
+                    {"oid": oid})
+            except ConnectionLost as e:
+                now = time.monotonic()
+                if owner_unreachable_since is None:
+                    owner_unreachable_since = now
+                if (now - owner_unreachable_since
+                        >= cfg.owner_unreachable_grace_s):
+                    return {"error": f"owner unreachable: {e}",
+                            "owner_dead": True}
+                await asyncio.sleep(poll)
+                continue
+            owner_unreachable_since = None
+            if loc is None:
+                return {"error": "owner does not know this object"}
+            if loc.get("pending"):
+                await asyncio.sleep(poll)
+                continue
+            for node in list(loc.get("nodes", ())):
+                if node == self.node_id:
+                    # Stale self-location (evicted): prune it so the
+                    # owner can recover instead of us spinning.
+                    await self._prune_at_owner(owner, oid, node)
+                    continue
+                try:
+                    r = await self.cluster.dispatch(
+                        self.node_id, node, "read_sim_object",
+                        {"oid": oid})
+                except ConnectionLost:
+                    if not self.cluster.is_alive(node):
+                        # Cluster says the holder is DEAD: prune so the
+                        # owner can start lineage reconstruction.
+                        await self._prune_at_owner(owner, oid, node)
+                    continue
+                if r.get("found"):
+                    self._objects[oid] = r["value"]
+                    return {"value": r["value"]}
+                await self._prune_at_owner(owner, oid, node)
+            if not loc.get("nodes"):
+                try:
+                    r = await self.cluster.dispatch(
+                        self.node_id, owner, "reconstruct_sim_object",
+                        {"oid": oid})
+                except ConnectionLost:
+                    await asyncio.sleep(poll)
+                    continue
+                if r and r.get("recovering"):
+                    await asyncio.sleep(poll)
+                    continue
+                return {"error": "no reachable copy"}
+            await asyncio.sleep(poll)
+        return {"error": "timeout"}
+
+    async def _prune_at_owner(self, owner: str, oid: str,
+                              node: str) -> None:
+        try:
+            await self.cluster.dispatch(
+                self.node_id, owner, "prune_sim_object_location",
+                {"oid": oid, "node": node})
+        except ConnectionLost:
+            pass
 
 
 class SimDriver:
@@ -303,6 +419,7 @@ class SimDriver:
     def __init__(self, cluster: "SimCluster", name: str = "driver"):
         self.cluster = cluster
         self.name = name
+        self.alive = True
         self._gcs = GcsClient(f"sim:{name}",
                               rpc=_SimChannel(cluster, name, "gcs"))
         self._rng = random.Random(cluster.seed ^ 0x5eed)
@@ -310,6 +427,21 @@ class SimDriver:
         self._next_pg = 0
         self.completed: List[str] = []
         self.lost: List[str] = []
+        # -- owned simulated objects (round 15) -------------------------
+        # oid -> {"pending": bool, "nodes": [node_id]} — the owner's
+        # location directory, the exact record handle_get_object_
+        # locations serves in production.
+        self._objects: Dict[str, Dict[str, Any]] = {}
+        # THE shared policy object: production's ClusterRuntime and this
+        # sim driver run the same retention/budget/inflight state
+        # machine (core/lineage.py).
+        self.lineage = LineageTable()
+        # producer tag -> executions (re-executions visible to tests)
+        self.exec_counts: Dict[str, int] = {}
+        # The driver's LOCAL raylet: every pull goes through it (its
+        # store caches pulled copies, exactly like a real worker's node
+        # store). Re-homed deterministically when it dies.
+        self.node: Optional[str] = None
 
     async def raylet_client_for(self, address: str) -> _RayletCaller:
         return _RayletCaller(self.cluster, self.name, address)
@@ -346,6 +478,153 @@ class SimDriver:
                                   bundle_index=idx)
             except ConnectionLost:
                 pass  # reconciler returns it against the REMOVED state
+
+    # -- simulated objects: put/get with lineage recovery (round 15) ----
+    async def create_object(self, tag: str, deps: Optional[List[str]]
+                            = None, max_retries: int = 3) -> str:
+        """Run one simulated producer task: lease a node, 'execute' (a
+        deterministic function of tag + resolved dep values, counted in
+        exec_counts), store the result on the leased node, and retain
+        the producing spec in the SHARED LineageTable so a lost copy
+        re-executes — recursively re-resolving deps that were lost
+        with their own nodes. Returns the oid."""
+        deps = list(deps or ())
+        self._next_task += 1
+        oid = f"simobj-{tag}"
+        self._objects[oid] = {"pending": True, "nodes": []}
+        self.lineage.retain([oid], {"name": tag, "tag": tag, "deps": deps},
+                            [], max_retries)
+        await self._exec_producer(oid, tag, deps)
+        return oid
+
+    async def _exec_producer(self, oid: str, tag: str,
+                             deps: List[str]) -> None:
+        """One (re-)execution of a producer: dep resolution (which may
+        itself reconstruct), lease, compute, store, publish location.
+        Mirrors _submit_async's retry discipline for transport loss."""
+        entry = self._objects[oid]
+        entry["pending"] = True
+        entry["nodes"] = []
+        dep_vals = [await self.get_object(d) for d in deps]
+        self.exec_counts[tag] = self.exec_counts.get(tag, 0) + 1
+        value = (f"{tag}({','.join(str(v) for v in dep_vals)})"
+                 if deps else f"{tag}()")
+        self._next_task += 1
+        rid = f"{oid}-x{self._next_task}"
+        for attempt in range(60):
+            node = self._pick_node()
+            if node is None:
+                await asyncio.sleep(backoff_delay(attempt, self._rng))
+                continue
+            try:
+                reply = await self._lease_chain(node, {"CPU": 1.0}, rid)
+                if reply is None or "lease_id" not in reply:
+                    await asyncio.sleep(backoff_delay(attempt, self._rng))
+                    continue
+                target = reply["node_id"]
+                await self.cluster.dispatch(self.name, target,
+                                            "store_sim_object",
+                                            {"oid": oid, "value": value})
+                await self._return_lease(target, reply["lease_id"])
+            except ConnectionLost:
+                await asyncio.sleep(backoff_delay(attempt, self._rng))
+                continue
+            entry["nodes"] = [target]
+            entry["pending"] = False
+            return
+        entry["pending"] = False  # directory: lost, nothing in flight
+        logger.warning("sim producer %s could not store its result", tag)
+
+    async def get_object(self, oid: str, owner: Optional[str] = None,
+                         timeout: float = 15.0) -> Any:
+        """A get() through a live raylet's pull loop (borrowers pass the
+        owner driver's name). Block-and-retries through reconstruction;
+        degrades to the production-typed errors when recovery is
+        impossible."""
+        owner = owner or self.name
+        deadline = time.monotonic() + timeout
+        attempt = 0
+        while True:
+            node = self._home_node()
+            if node is None:
+                if time.monotonic() >= deadline:
+                    raise GetTimeoutError(f"no live node to pull {oid}")
+                await asyncio.sleep(backoff_delay(attempt, self._rng))
+                attempt += 1
+                continue
+            try:
+                r = await self.cluster.dispatch(
+                    self.name, node, "pull_sim_object",
+                    {"oid": oid, "owner": owner,
+                     "pull_timeout": max(0.1, deadline - time.monotonic())})
+            except ConnectionLost:
+                # The pulling raylet itself died mid-get: re-pull via a
+                # survivor (the production client's retry path).
+                if time.monotonic() >= deadline:
+                    raise GetTimeoutError(f"timed out pulling {oid}")
+                await asyncio.sleep(backoff_delay(attempt, self._rng))
+                attempt += 1
+                continue
+            if "value" in r:
+                return r["value"]
+            err = r.get("error", "")
+            if r.get("owner_dead"):
+                raise OwnerDiedError(oid)
+            if "timeout" in err:
+                raise GetTimeoutError(f"timed out pulling {oid}: {err}")
+            raise ObjectLostError(oid)
+
+    # owner-side directory handlers (the sim's CoreWorkerService) ------
+    async def handle_get_sim_object_locations(
+            self, conn, *, oid: str) -> Optional[Dict[str, Any]]:
+        e = self._objects.get(oid)
+        if e is None:
+            return None
+        return {"pending": bool(e["pending"]), "nodes": list(e["nodes"])}
+
+    async def handle_prune_sim_object_location(self, conn, *, oid: str,
+                                               node: str) -> bool:
+        e = self._objects.get(oid)
+        if e is None:
+            return True
+        if node in e["nodes"]:
+            e["nodes"] = [n for n in e["nodes"] if n != node]
+            if not e["nodes"] and not e["pending"]:
+                self._trigger_sim_reconstruction(oid)
+        return True
+
+    async def handle_reconstruct_sim_object(self, conn, *,
+                                            oid: str) -> Dict[str, Any]:
+        e = self._objects.get(oid)
+        if e is None:
+            return {"recovering": False, "known": False}
+        if e["pending"]:
+            return {"recovering": True}
+        if e["nodes"]:
+            return {"recovering": True}
+        return {"recovering": self._trigger_sim_reconstruction(oid)}
+
+    def _trigger_sim_reconstruction(self, oid: str) -> bool:
+        """The owner's recovery decision — the SAME LineageTable verdict
+        machine production's _trigger_reconstruction consults, driving
+        the sim's re-execution path."""
+        verdict, rec = self.lineage.begin_reexec(oid)
+        if verdict == lineage_mod.INFLIGHT:
+            return True
+        if verdict != lineage_mod.STARTED:
+            if verdict == lineage_mod.EXHAUSTED:
+                logger.warning("sim object %s lost; budget exhausted", oid)
+            return False
+        spec = rec["spec"]
+
+        async def _re():
+            try:
+                await self._exec_producer(oid, spec["tag"], spec["deps"])
+            finally:
+                self.lineage.end_reexec(rec)
+
+        asyncio.ensure_future(_re())
+        return True
 
     # -- simulated tasks -----------------------------------------------
     async def submit_task(self, resources: Optional[Dict[str, float]]
@@ -419,6 +698,16 @@ class SimDriver:
             return None
         return self._rng.choice(live)
 
+    def _home_node(self) -> Optional[str]:
+        """This driver's local raylet (pulls route through it; its
+        store caches the copies). Deterministic re-home on death."""
+        if self.node is not None and self.cluster.is_alive(self.node):
+            return self.node
+        live = sorted(n for n, r in self.cluster.raylets.items()
+                      if r.alive)
+        self.node = live[0] if live else None
+        return self.node
+
 
 class SimCluster:
     """N simulated raylets + one real GcsServer + a fault plan, in one
@@ -444,6 +733,27 @@ class SimCluster:
         # (src, dst, epoch) -> LoopbackClient bound to the live target
         self._conns: Dict[Tuple[str, str, int], LoopbackClient] = {}
         self.driver = SimDriver(self)
+        # Dispatch-addressable drivers (the OWNER side of the object
+        # plane: raylets pull locations / prune / reconstruct against
+        # them). Borrower drivers register here too via add_driver.
+        self.drivers: Dict[str, SimDriver] = {self.driver.name: self.driver}
+
+    def add_driver(self, name: str) -> SimDriver:
+        """A second owner/borrower process (e.g. the borrower of the
+        data-plane acceptance scenario)."""
+        drv = SimDriver(self, name=name)
+        self.drivers[name] = drv
+        return drv
+
+    def _new_gcs(self) -> GcsServer:
+        """A GcsServer whose outbound raylet clients (PG reschedule 2PC)
+        ride the fault-injected sim dispatch, set BEFORE start() so
+        crash-resumed reschedules of recovered RESCHEDULING groups go
+        through the plan too."""
+        gcs = GcsServer(storage_path=self.storage_path)
+        gcs.raylet_client_factory = (
+            lambda addr: _RayletCaller(self, "gcs", addr))
+        return gcs
 
     # -- lifecycle ------------------------------------------------------
     async def start(self) -> None:
@@ -451,7 +761,7 @@ class SimCluster:
         self._saved_config = dict(cfg._values)
         cfg.apply_system_config(self._config_overrides)
         self._wire_crashes()
-        self.gcs = GcsServer(storage_path=self.storage_path)
+        self.gcs = self._new_gcs()
         await self.gcs.start(serve_rpc=False)
         for i in range(self.num_nodes):
             node_id = f"simnode{i:04d}"
@@ -461,8 +771,15 @@ class SimCluster:
         await asyncio.gather(*(r.start() for r in self.raylets.values()))
 
     async def stop(self) -> None:
+        # Mass-cancel first, then reap: awaiting each heartbeat task's
+        # cancellation one by one costs a full scheduler pass through
+        # every still-runnable loop per node — 135 s at N=1000.
         for r in self.raylets.values():
-            await r.stop()
+            r.alive = False
+            if r._hb_task is not None:
+                r._hb_task.cancel()
+        await asyncio.gather(*(r.stop() for r in self.raylets.values()),
+                             return_exceptions=True)
         if self.gcs is not None:
             await self.gcs.stop()
             self.gcs = None
@@ -493,13 +810,19 @@ class SimCluster:
         if dst == "gcs":
             return self.gcs is not None
         r = self.raylets.get(dst)
-        return r is not None and r.alive
+        if r is not None:
+            return r.alive
+        d = self.drivers.get(dst)
+        return d is not None and d.alive
 
     def _target(self, dst: str) -> Optional[Any]:
         if dst == "gcs":
             return self.gcs
         r = self.raylets.get(dst)
-        return r if (r is not None and r.alive) else None
+        if r is not None:
+            return r if r.alive else None
+        d = self.drivers.get(dst)
+        return d if (d is not None and d.alive) else None
 
     async def _client(self, src: str, dst: str,
                       target: Any) -> LoopbackClient:
@@ -557,13 +880,19 @@ class SimCluster:
             self.gcs._health_task.cancel()
         if self.gcs._snapshot_task is not None:
             self.gcs._snapshot_task.cancel()
+        for task in self.gcs._reschedule_tasks.values():
+            # Reschedule passes die with the process; the restarted
+            # instance resumes them from the written-through
+            # RESCHEDULING records.
+            task.cancel()
+        self.gcs._reschedule_tasks.clear()
         self.gcs._storage_path = None
         self.gcs = None
         self.gcs_epoch += 1
 
     async def restart_gcs(self) -> None:
         assert self.storage_path, "restart needs persistent storage"
-        self.gcs = GcsServer(storage_path=self.storage_path)
+        self.gcs = self._new_gcs()
         await self.gcs.start(serve_rpc=False)
         self.gcs_epoch += 1
 
@@ -571,6 +900,16 @@ class SimCluster:
         raylet = self.raylets.get(node_id)
         if raylet is not None:
             raylet.crash()
+
+    def evict_sim_object(self, oid: str) -> int:
+        """Drop every live raylet's copy of a sim object (the LRU/
+        delete eviction stand-in): the next pull must recover through
+        the owner's directory — prune, then lineage re-execution."""
+        n = 0
+        for r in self.alive_raylets():
+            if r._objects.pop(oid, None) is not None:
+                n += 1
+        return n
 
     # -- invariants -----------------------------------------------------
     def alive_raylets(self) -> List[SimRaylet]:

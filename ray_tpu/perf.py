@@ -471,16 +471,38 @@ def run_simcluster_bench(n_nodes: int = 100,
     GcsServer with `n_nodes` in-process raylets (core/simcluster.py).
     No OS processes, no sockets — the numbers isolate the control
     plane's own code from box fork/exec noise, so a regression here is
-    a scheduling/GCS-path regression, full stop."""
+    a scheduling/GCS-path regression, full stop.
+
+    Round 15 adds the WAL-checkpoint measurement (ROADMAP 3c): with the
+    node table + PG records + a KV payload populated, kill -9 the GCS
+    and time the restart (storage load, WAL replay, resumption scans) —
+    `gcs_restart_ms`, guarded by a fold-best ceiling in
+    tests/test_perf_guards.py."""
     import asyncio
+    import os
+    import tempfile
 
     from ray_tpu.core.simcluster import SimCluster
 
     n_tasks = max(50, int(400 * scale))
     n_pgs = max(8, int(40 * scale))
+    n_kv = max(50, int(200 * scale))
 
-    async def bench() -> Dict[str, Any]:
-        cluster = SimCluster(num_nodes=n_nodes, seed=0)
+    # At 1000 nodes the compressed sim timers themselves become the
+    # load: the heartbeat volume + full-table view refreshes saturate
+    # the one event loop, heartbeats fall behind the health deadline,
+    # and the false-death/re-register storm never converges (PROFILE
+    # round 11). Scale the timers with N like a real deployment would.
+    big = n_nodes > 200
+    sim_config = ({"raylet_heartbeat_period_ms": 1000,
+                   "cluster_view_refresh_ms": 10000,
+                   "health_check_period_ms": 2000,
+                   "health_check_failure_threshold": 10} if big else None)
+
+    async def bench(storage_path: str) -> Dict[str, Any]:
+        cluster = SimCluster(num_nodes=n_nodes, seed=0,
+                             storage_path=storage_path,
+                             config=sim_config)
         await cluster.start()
         try:
             assert await cluster.wait_until(
@@ -508,16 +530,50 @@ def run_simcluster_bench(n_nodes: int = 100,
             assert all(state == "CREATED" for _, state in created), (
                 [s for _, s in created])
             leaked = cluster.leaked_reservations()
+
+            # -- WAL checkpoint round 2 (ROADMAP 3c): restart time ----
+            # Populate "large tables": a KV payload on top of the live
+            # node table (every put is write-through, so this also
+            # exercises WAL append + fsync), plus standing PGs.
+            standing = [
+                await cluster.driver.create_placement_group(
+                    [{"CPU": 1.0}] * 2, strategy="PACK")
+                for _ in range(max(4, n_pgs // 4))]
+            payload = os.urandom(4096)
+            for i in range(n_kv):
+                await cluster.driver._gcs.kv_put(
+                    f"bench/restart/{i}".encode(), payload)
+            await cluster.gcs.flush_now()
+            wal_bytes = 0
+            for p in (storage_path, storage_path + ".wal"):
+                if os.path.exists(p):
+                    wal_bytes += os.path.getsize(p)
+            t0 = time.perf_counter()
+            cluster.kill_gcs()
+            await cluster.restart_gcs()
+            restart_ms = (time.perf_counter() - t0) * 1e3
+            recovered_nodes = sum(
+                1 for n in cluster.gcs.nodes.values() if n.get("alive"))
+            recovered_kv = sum(
+                1 for k in cluster.gcs.kv if k.startswith("bench/"))
+            assert recovered_kv == n_kv, (recovered_kv, n_kv)
+            for pg_id, _ in standing:
+                await cluster.driver.remove_placement_group(pg_id)
             return {
                 "sim_nodes": n_nodes,
                 "lease_grants_per_s": round(n_tasks / lease_dt, 1),
                 "placements_per_s": round(n_pgs / pg_dt, 1),
                 "sim_leaked_reservations": len(leaked),
+                "gcs_restart_ms": round(restart_ms, 1),
+                "gcs_storage_bytes": wal_bytes,
+                "gcs_restart_recovered_nodes": recovered_nodes,
+                "gcs_restart_kv_rows": n_kv,
             }
         finally:
             await cluster.stop()
 
-    return asyncio.run(bench())
+    with tempfile.TemporaryDirectory() as td:
+        return asyncio.run(bench(os.path.join(td, "gcs.pkl")))
 
 
 def run_llm_serve_bench(scale: float = 1.0) -> Dict[str, Any]:

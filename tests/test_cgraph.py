@@ -261,10 +261,69 @@ def test_compiled_cluster_loop_actor_death(ray_cluster):
     ray_tpu.kill(b)
     # In-flight and follow-up executions surface the death as an error
     # (never a hang): either at execute() once the edge is torn, or at
-    # ray.get via the error channel / owner state.
+    # ray.get via the error channel / owner state. These actors carry
+    # no max_task_retries budget, so the round-15 restart path must NOT
+    # engage — the graph stays terminally poisoned as before.
     with pytest.raises((RayError, GetTimeoutError, Exception)):
         ref = compiled.execute(1)
         ray_tpu.get(ref, timeout=30)
     compiled.teardown()
     # Survivors keep serving the normal task plane.
     assert ray_tpu.get(a.add.remote(1), timeout=60) == 2
+
+
+@pytest.mark.cluster
+def test_compiled_graph_restarts_through_actor_death(ray_cluster):
+    """Round-15 carryover: an actor death no longer poisons a compiled
+    graph permanently when the actors carry restart budget
+    (max_restarts + max_task_retries). In-flight executions at the
+    death still fail with the actor-death error; the next execute()
+    recompiles the dead actor's schedule onto its restarted replacement
+    and the graph resumes. The restart is pinned in the flight ring
+    (`cgraph.restart`) so /api/timeline attributes the recovery."""
+    import os
+    import signal
+
+    ray_tpu = ray_cluster
+    from ray_tpu.core import flight
+    from ray_tpu.dag import InputNode
+
+    Stage = _stage(ray_tpu)
+    a = Stage.options(max_restarts=2, max_task_retries=2).remote(1)
+    b = Stage.options(max_restarts=2, max_task_retries=2).remote(10)
+    c = Stage.options(max_restarts=2, max_task_retries=2).remote(100)
+    ray_tpu.get([s.count.remote() for s in (a, b, c)], timeout=120)
+    with InputNode() as inp:
+        dag = c.add.bind(b.add.bind(a.add.bind(inp)))
+
+    compiled = dag.experimental_compile()
+    assert ray_tpu.get(compiled.execute(0), timeout=60) == 111
+    assert compiled._restarts_left >= 1
+
+    # SIGKILL the middle actor's worker process (harder than ray.kill:
+    # nothing marks the owner state DEAD, the first push discovers it).
+    pid = ray_tpu.get(b.__ray_call__.remote(
+        lambda inst: __import__("os").getpid()), timeout=60)
+    os.kill(pid, signal.SIGKILL)
+
+    # Drive executes until the death is observed, the graph revives,
+    # and a post-restart execution completes correctly. Refs in flight
+    # at the death may fail with the actor-death error — later ones
+    # must succeed.
+    deadline = time.time() + 120
+    recovered = False
+    while time.time() < deadline and not recovered:
+        try:
+            ref = compiled.execute(5)
+            assert ray_tpu.get(ref, timeout=60) == 116
+            recovered = True
+        except Exception:
+            time.sleep(0.5)
+    assert recovered, "graph never revived through the actor restart"
+    # Steady state after recovery: several more executions flow.
+    for x in (1, 2, 3):
+        assert ray_tpu.get(compiled.execute(x), timeout=60) == x + 111
+    # The recovery left its mark for the merged timeline.
+    events = flight.dump(include_events=True)["events"]
+    assert any(e[3] == "cgraph.restart" for e in events)
+    compiled.teardown()

@@ -352,6 +352,42 @@ def test_flight_timeline_endpoint(ray_cluster):
     assert any("driver" in m["args"]["name"] for m in metas), metas
 
 
+def test_timeline_attributes_recovery_events(ray_cluster):
+    """Round-15 recovery work is attributable in the merged timeline:
+    `lineage.reexec` / `pg.reschedule` / `cgraph.restart` events
+    recorded in a process's flight ring surface through /api/timeline
+    with their categories intact. (The real recovery paths emit them —
+    pinned in test_unit_simcluster and test_cgraph; this pins the
+    dashboard surface end to end via the driver's registered flight
+    source.)"""
+    import time
+
+    import ray_tpu
+    from ray_tpu.core import flight
+
+    base = _dashboard_url(ray_tpu)
+    flight.instant("lineage", "lineage.reexec", arg="probe left=1")
+    flight.instant("pg", "pg.reschedule", arg="probe n=1")
+    flight.instant("cgraph", "cgraph.restart", arg="probe left=1")
+    want = {"lineage.reexec", "pg.reschedule", "cgraph.restart"}
+    deadline = time.time() + 30
+    names: set = set()
+    while time.time() < deadline:
+        status, body = _get(base + "/api/timeline?window_s=60")
+        assert status == 200
+        trace = json.loads(body)
+        names = {e["name"] for e in trace["traceEvents"]
+                 if e["ph"] != "M"}
+        if want <= names:
+            break
+        time.sleep(0.5)
+    assert want <= names, sorted(names)[:40]
+    cats = {e["name"]: e.get("cat") for e in trace["traceEvents"]
+            if e["ph"] != "M" and e["name"] in want}
+    assert cats == {"lineage.reexec": "lineage", "pg.reschedule": "pg",
+                    "cgraph.restart": "cgraph"}, cats
+
+
 def test_flight_stalls_endpoint_shape(ray_cluster):
     """`/api/stalls` always answers with a list; episodes (when any
     process stalled) carry the lag measurement + identity fields."""

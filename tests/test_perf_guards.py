@@ -212,6 +212,12 @@ def test_flight_recorder_overhead():
 # create+remove churn — is the sharp edge.
 SIM_FLOOR_LEASE_GRANTS_PER_S = 4000.0
 SIM_FLOOR_PLACEMENTS_PER_S = 4.0
+# GCS restart at 100 nodes x populated tables (WAL checkpoint round 2,
+# ROADMAP 3c): measured 6 ms fresh (~0.9 MB snapshot+WAL, 100 nodes +
+# 100 KV rows + standing PGs) — dead-stable across rounds. Fold-best
+# ceiling at 10x: trips if restart regresses to rescanning state
+# per-record, losing compaction, or fsyncing on the load path.
+SIM_CEIL_GCS_RESTART_MS = 60.0
 
 
 def test_simcluster_control_plane_floor():
@@ -221,6 +227,7 @@ def test_simcluster_control_plane_floor():
     for _ in range(ROUNDS):
         r = run_simcluster_bench(n_nodes=100, scale=0.5)
         assert r["sim_leaked_reservations"] == 0, r
+        assert r["gcs_restart_recovered_nodes"] == 100, r
         if not best:
             best = r
         else:
@@ -230,14 +237,20 @@ def test_simcluster_control_plane_floor():
                                           r["lease_grants_per_s"]),
                 "placements_per_s": max(best["placements_per_s"],
                                         r["placements_per_s"]),
+                "gcs_restart_ms": min(best["gcs_restart_ms"],
+                                      r["gcs_restart_ms"]),
             }
         if (best["lease_grants_per_s"] >= SIM_FLOOR_LEASE_GRANTS_PER_S
                 and best["placements_per_s"]
-                >= SIM_FLOOR_PLACEMENTS_PER_S):
+                >= SIM_FLOOR_PLACEMENTS_PER_S
+                and best["gcs_restart_ms"] <= SIM_CEIL_GCS_RESTART_MS):
             break
     assert best["lease_grants_per_s"] >= SIM_FLOOR_LEASE_GRANTS_PER_S, (
         f"simcluster lease-grant floor violated: {best}\n"
         "attribute with: python -m ray_tpu.perf --simcluster")
     assert best["placements_per_s"] >= SIM_FLOOR_PLACEMENTS_PER_S, (
         f"simcluster placement floor violated: {best}\n"
+        "attribute with: python -m ray_tpu.perf --simcluster")
+    assert best["gcs_restart_ms"] <= SIM_CEIL_GCS_RESTART_MS, (
+        f"GCS restart ceiling violated: {best}\n"
         "attribute with: python -m ray_tpu.perf --simcluster")

@@ -22,6 +22,7 @@ import asyncio
 import pytest
 
 from ray_tpu.core.cluster_runtime import ClusterRuntime, _ActorState, _Owned
+from ray_tpu.core.lineage import LineageTable
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.rpc import ConnectionLost
 from ray_tpu.core.rpc_testing import LoopbackClient
@@ -49,7 +50,7 @@ class _OwnerHarness(ClusterRuntime):
         self._borrowed = {}
         self._borrowed_lock = threading.Lock()
         self._shard_children = {}
-        self._lineage = {}
+        self._lineage = LineageTable()
         self._shutdown = False
         self._shm_by_oid = {}
         self._local_shm = {}
@@ -262,7 +263,7 @@ class _RetryHarness(ClusterRuntime):
         self._borrowed = {}
         self._borrowed_lock = threading.Lock()
         self._shard_children = {}
-        self._lineage = {}
+        self._lineage = LineageTable()
         self._generators = {}
         self._inflight_task_workers = {}
         self._cancel_requested = set()

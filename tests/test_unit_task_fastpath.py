@@ -283,6 +283,9 @@ def _batch_raylet(idle_workers: int, cpu: float = 4.0):
     r._bundles = {}
     r._lease_conns = {}
     r._recent_grants = {}
+    r._lease_reply_cache = {}
+    r._lease_inflight = {}
+    r._cancelled_lease_requests = {}
     r._chips_free = []
     r._next_lease = 0
     r._stopping = False
@@ -301,6 +304,107 @@ def _lease_req_wire(count: int, request_id: str = "req1") -> dict:
 
     return to_wire(LeaseRequest(resources={"CPU": 1.0}, count=count,
                                 request_id=request_id, job_id="j"))
+
+
+def test_duplicate_lease_rpcs_never_double_grant_or_double_recycle():
+    """Round-15 chaos pin: the batched lease plane is at-least-once
+    safe. A fault-injected DUPLICATE delivery of request_worker_leases
+    must be served the original grants from the request_id reply cache
+    (never a second worker set that no client would ever return), and a
+    duplicated return_worker_leases must recycle each worker exactly
+    once (the lease_id guard makes the redelivery a no-op)."""
+    from ray_tpu.core import faults
+
+    r = _batch_raylet(idle_workers=4)
+
+    async def main():
+        client = LoopbackClient(r)
+        await client.connect(handshake=False)
+        plan = faults.FaultPlan(seed=0)
+        plan.duplicate(method="request_worker_leases", p=1.0)
+        plan.duplicate(method="return_worker_leases", p=1.0)
+        faults.install(plan)
+        try:
+            reply = await client.call(
+                "request_worker_leases",
+                req=_lease_req_wire(count=2, request_id="rq-dup"))
+            grants = reply["grants"]
+            assert len(grants) == 2
+            for _ in range(10):       # let the duplicate dispatch land
+                await asyncio.sleep(0)
+            leased = [w for w in r._workers.values()
+                      if w.state == "leased"]
+            assert len(leased) == 2, [w.state
+                                      for w in r._workers.values()]
+            assert r.resources_available["CPU"] == 2.0
+            # And the duplicate was answered from the cache: the cached
+            # reply IS the original grant set.
+            assert r._lease_reply_cache["rq-dup"]["grants"] == grants
+
+            returns = [{"lease_id": g["lease_id"],
+                        "worker_id": g["worker_id"]} for g in grants]
+            assert await client.call("return_worker_leases",
+                                     returns=returns)
+            for _ in range(10):
+                await asyncio.sleep(0)
+            assert r.resources_available["CPU"] == 4.0
+            idle = [w for w in r._workers.values() if w.state == "idle"]
+            assert len(idle) == 4
+            # No double-append into the idle pool (a duplicate recycle
+            # would hand one worker to two future leases).
+            assert len(r._idle) == 4
+            assert len(set(id(w) for w in r._idle)) == 4
+        finally:
+            faults.uninstall()
+
+    _run(main())
+
+
+def test_cancel_racing_inflight_grant_is_not_recached():
+    """Review race: a cancel landing BETWEEN the grant (future
+    resolved, _recent_grants recorded) and the lease handler resuming
+    must not let the resumed handler cache the reply — a later
+    at-least-once duplicate would be served a grant whose workers the
+    cancel already reclaimed (possibly re-leased to someone else)."""
+    from ray_tpu.core.raylet import _Worker
+
+    r = _batch_raylet(idle_workers=0)
+
+    async def main():
+        client = LoopbackClient(r)
+        await client.connect(handshake=False)
+        task = asyncio.ensure_future(client.call(
+            "request_worker_lease",
+            req=_lease_req_wire(count=1, request_id="rq-race")))
+        for _ in range(20):            # queue the pending
+            await asyncio.sleep(0)
+            if r._pending:
+                break
+        assert r._pending
+        # Capacity appears: the grant resolves the pending future and
+        # records _recent_grants — the handler coroutine has NOT yet
+        # resumed past `await pending.future`.
+        w = _Worker("wlate", _FakeProc())
+        w.state = "idle"
+        w.address = "w:late"
+        r._workers[w.worker_id] = w
+        r._idle.append(w)
+        r._try_dispatch()
+        assert "rq-race" in r._recent_grants
+        assert "rq-race" not in r._lease_reply_cache
+        # The client's timeout cancel wins the race to the loop.
+        assert await client.call("cancel_lease_request",
+                                 request_id="rq-race")
+        reply = await task
+        # The stale reply still reaches the (long gone) caller, but it
+        # must never enter the duplicate-serving cache...
+        assert reply.get("granted")
+        assert "rq-race" not in r._lease_reply_cache
+        # ...and the cancel reclaimed the worker.
+        assert w.state == "idle" and w.lease_id is None
+        assert r.resources_available["CPU"] == 4.0
+
+    _run(main())
 
 
 def test_raylet_grants_batch_up_to_capacity():
