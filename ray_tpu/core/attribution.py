@@ -56,6 +56,25 @@ ring.reply); ``lease.return_batch`` is the return-side mirror of
 ``lease.batch_size`` (count = batched return RPCs, mean/max = leases
 returned per RPC).
 
+Round-16 caller-thread dispatch labels: ``submit.caller_enq`` counts
+submits the CALLER thread published straight onto a worker ring (the
+fifth dispatch tier — no loop wakeup, no coroutine; compare against
+``submit.remote`` for the tier split) and ``submit.caller_rtt`` times
+their publish→completion round trip; ``submit.caller_fallback`` counts
+caller attempts that exhausted the bounded full-ring wait and fell
+back to the loop-hop queue (the <5% honesty bound in the perf guard);
+``ring.handoff`` counts producer-side ownership migrations through the
+ProducerLatch (loop ⇄ caller ⇄ teardown — a ping-ponging latch would
+eat the tier's win); ``ring.producer_violation`` counts overlapping
+pushes the writer's re-entrancy sentinel observed (MUST stay 0 — a
+nonzero value means the SPSC invariant broke); ``ring.busy_poll`` /
+``worker.busy_poll`` count post-drain spin windows that found the next
+entry without an epoll wakeup (driver reply side / worker submit
+side), ``ring.busy_poll_hit`` the spins that paid off inside
+`ring.busy_poll()` itself; ``inline.revoked`` counts cost-model-v2
+revocation windows (inlining suspended under caller-dispatch
+pressure). All are counts, not durations, except ``submit.caller_rtt``.
+
 Data-plane counters (round 7, the zero-copy audit — counts, not
 durations): ``get.nd_view`` array gets served as a zero-copy view over
 the store segment (no pickler ran); ``put.sharded``/``get.sharded``

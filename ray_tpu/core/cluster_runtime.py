@@ -300,6 +300,46 @@ class _LeasePool:
         self._max_inflight: Optional[int] = None
 
 
+class _CallerTask:
+    """Bookkeeping record for one caller-thread ring enqueue (round 16).
+
+    The loop-hop path parks a per-task asyncio future in the ring's
+    waiter map and resumes a coroutine per completion; the caller tier
+    parks THIS record instead, and the reply-ring drain finishes the
+    task inline on the loop thread — N completions per wakeup, zero
+    future-resolution hops. Carries exactly what the completion (or the
+    ConnectionLost retry resumption) needs."""
+
+    __slots__ = ("spec", "refs", "pinned", "sched_key", "tmpl", "worker",
+                 "fn_key", "args_len", "push_t0")
+
+    def __init__(self, spec, refs, pinned, sched_key, tmpl, worker,
+                 fn_key, args_len, push_t0):
+        self.spec = spec
+        self.refs = refs
+        self.pinned = pinned
+        self.sched_key = sched_key
+        self.tmpl = tmpl
+        self.worker = worker
+        self.fn_key = fn_key
+        self.args_len = args_len
+        self.push_t0 = push_t0
+
+
+# Inline cost model v2 (round 16): arg-size buckets for the per-fn exec
+# EMA. Boundaries are coarse on purpose — the gate estimates sizes from
+# raw args (pre-serialization) while the EMA keys on the serialized
+# blob length, and wide buckets keep boundary-crossing mismatches rare.
+_SIZE_BUCKETS = (1024, 16 * 1024, 256 * 1024)
+
+
+def _size_bucket(nbytes: int) -> int:
+    for i, bound in enumerate(_SIZE_BUCKETS):
+        if nbytes <= bound:
+            return i
+    return len(_SIZE_BUCKETS)
+
+
 class ClusterRuntime:
     is_local_mode = False
 
@@ -379,6 +419,31 @@ class ClusterRuntime:
         self._ring_slots = cfg.submit_ring_slots
         self._ring_slot_bytes = cfg.submit_ring_slot_bytes
         self._lease_return_batching = cfg.lease_return_batching
+        # Round-16 caller-thread dispatch tier: the submitting thread
+        # pushes template deltas onto an already-attached worker ring
+        # directly (no loop hop), under per-ring ProducerLatch handoff.
+        # Only meaningful on top of worker-direct rings.
+        self._caller_dispatch = (cfg.task_caller_dispatch
+                                 and self._ring_enabled)
+        self._caller_push_wait_s = max(
+            0.0, cfg.caller_push_wait_ms / 1000.0)
+        self._busy_poll_s = max(0, cfg.ring_busy_poll_us) / 1e6
+        # Round-16 inline cost model v2: arg-size-conditional EMAs +
+        # revocation under caller-thread dispatch pressure.
+        self._inline_v2 = cfg.inline_cost_model_v2
+        self._inline_revoke_pressure = max(1, cfg.inline_revoke_pressure)
+        self._inline_revoke_window_s = max(
+            0.001, cfg.inline_revoke_window_ms / 1000.0)
+        self._inline_revoked_until = 0.0
+        self._caller_window_start = 0.0
+        self._caller_window_count = 0
+        # Caller-dispatch registry: sched_key -> {worker_id: (worker,
+        # ring_st)} for ring-attached leased workers the caller thread
+        # may target directly. Maintained by the loop thread (offer on
+        # successful loop-path ring publish, removal in ring teardown);
+        # read by caller threads under _caller_lock.
+        self._caller_rings: Dict[str, dict] = {}
+        self._caller_lock = threading.Lock()
         # Flight recorder (round 12): always-on event ring + loop-lag
         # watchdog on this process's RPC loop. The config flag gates
         # the whole subsystem per process (workers read it through the
@@ -1504,6 +1569,18 @@ class ClusterRuntime:
             # lineage_reconstruction flag is off.
             rec = self._lineage.retain([r.hex() for r in refs], spec,
                                        pinned, opts.max_retries)
+        # Round-16 caller-thread dispatch (tier 5): a ring-eligible
+        # submit against an already-leased, already-ringed worker whose
+        # template is registered publishes from THIS thread — no loop
+        # wakeup, no coroutine. Any miss falls through to the loop-hop
+        # queue below, byte-identically.
+        if (self._caller_dispatch and tmpl is not None and not streaming
+                and self._try_caller_dispatch(
+                    spec, refs, pinned if rec is None else None,
+                    sched_key, tmpl)):
+            if opts.num_returns == 0:
+                return None
+            return refs[0] if opts.num_returns == 1 else refs
         self._enqueue_submit(
             ("task", spec, refs, pinned if rec is None else None,
              sched_key, tmpl))
@@ -1534,8 +1611,18 @@ class ClusterRuntime:
 
         `.options(_metadata={"inline": False})` opts a call site out
         (perf.py uses it to keep measuring the remote plane).
+
+        Cost model v2 (round 16): the EMA is arg-size-conditional —
+        keyed by (fn, size bucket) — and the whole tier is revocable
+        under caller-thread dispatch pressure (the caller thread that
+        would run this inline is busy being a ring producer; stealing
+        it starves every worker the ring feeds).
         """
-        ema = self._fn_cost.get(fn_key)
+        if self._inline_v2 and self._inline_revoked_until:
+            if time.monotonic() < self._inline_revoked_until:
+                return False
+            self._inline_revoked_until = 0.0
+        ema = self._fn_cost_lookup(fn_key, args, kwargs)
         if ema is None or ema > self._inline_threshold_s:
             return False
         if opts.num_returns in ("streaming", "dynamic"):
@@ -1579,12 +1666,100 @@ class ClusterRuntime:
         # eviction just makes the inline run pull like a worker would).
         return oid in self._local_shm
 
-    def _update_fn_cost(self, fn_key: str, dt: float) -> None:
-        prev = self._fn_cost.get(fn_key)
-        self._fn_cost[fn_key] = (dt if prev is None
-                                 else 0.7 * prev + 0.3 * dt)
+    def _update_fn_cost(self, fn_key: str, dt: float,
+                        arg_bytes: Optional[int] = None) -> None:
+        """Feed the exec-time EMA. V2 keys it by (fn, arg-size bucket)
+        when the observation carries the serialized-args length; v1 (or
+        an observation without one) keeps the plain scalar key."""
+        key: Any = fn_key
+        if self._inline_v2 and arg_bytes is not None:
+            key = (fn_key, _size_bucket(arg_bytes))
+        prev = self._fn_cost.get(key)
+        self._fn_cost[key] = (dt if prev is None
+                              else 0.7 * prev + 0.3 * dt)
         if len(self._fn_cost) > 4096:
             self._fn_cost.clear()  # bounded, simple (re-learns)
+
+    def _fn_cost_lookup(self, fn_key: str, args, kwargs
+                        ) -> Optional[float]:
+        """Gate-side EMA lookup. V2: estimate the call's arg footprint
+        cheaply (no serialization — this runs per submit) and read the
+        matching bucket; an unknown bucket inherits *downward* from a
+        known-tiny LARGER bucket (a fn observed cheap on bigger args is
+        cheap on smaller ones — the converse never holds, so small-arg
+        evidence can't promote big-arg calls)."""
+        if not self._inline_v2:
+            return self._fn_cost.get(fn_key)
+        b = _size_bucket(self._arg_size_estimate(args, kwargs))
+        ema = self._fn_cost.get((fn_key, b))
+        if ema is not None:
+            return ema
+        for bigger in range(b + 1, len(_SIZE_BUCKETS) + 1):
+            bema = self._fn_cost.get((fn_key, bigger))
+            if bema is not None and bema <= self._inline_threshold_s:
+                return bema
+        # Legacy scalar observations (v1 runs, or updates without a
+        # size) still count — the tier must not go cold on upgrade.
+        return self._fn_cost.get(fn_key)
+
+    @staticmethod
+    def _arg_size_estimate(args, kwargs) -> int:
+        """Cheap (non-serializing) arg-footprint estimate for bucket
+        selection: exact for bytes/str/arrays, shallow for small
+        containers, a fixed opaque default otherwise. Only needs to
+        land in the right coarse bucket, not be right."""
+        total = 0
+        items = list(args) + list(kwargs.values())
+        for a in items:
+            if isinstance(a, (bytes, bytearray, str)):
+                total += len(a)
+            elif isinstance(a, (int, float, bool)) or a is None:
+                total += 8
+            elif isinstance(a, ObjectRef):
+                total += 64  # passed by reference
+            elif hasattr(a, "nbytes"):
+                try:
+                    total += int(a.nbytes)
+                except Exception:
+                    total += 512
+            elif isinstance(a, (list, tuple, set)) and len(a) <= 64:
+                for x in a:
+                    if isinstance(x, (bytes, bytearray, str)):
+                        total += len(x)
+                    elif isinstance(x, (int, float, bool)) or x is None:
+                        total += 8
+                    else:
+                        total += 512
+            elif isinstance(a, dict) and len(a) <= 64:
+                total += 64 * (len(a) + 1)
+            else:
+                total += 512
+        return total
+
+    def _note_caller_pressure(self) -> None:
+        """Caller-thread dispatch pressure signal (v2 revocation): a
+        sustained run of caller enqueues within one sliding window
+        means the caller thread IS the dispatch tier right now —
+        revoke inlining for a window so it keeps producing instead of
+        stealing itself for user code. Runs on the caller thread; the
+        fields are process-local and a lost update under the GIL just
+        shifts the window by one sample."""
+        if not self._inline_v2:
+            return
+        now = time.monotonic()
+        if now - self._caller_window_start > self._inline_revoke_window_s:
+            self._caller_window_start = now
+            self._caller_window_count = 0
+        self._caller_window_count += 1
+        if self._caller_window_count >= self._inline_revoke_pressure:
+            self._inline_revoked_until = (
+                now + self._inline_revoke_window_s)
+            self._caller_window_start = now
+            self._caller_window_count = 0
+            if attribution.enabled:
+                attribution.count("inline.revoked")
+            if flight.enabled:
+                flight.instant("task", "inline_revoked")
 
     def _submit_inline(self, remote_function, fn_key: str, opts,
                        args, kwargs):
@@ -1620,7 +1795,7 @@ class ClusterRuntime:
         # from the inline tier for the next ~7 calls.
         exec_us = reply.get("exec_us")
         if exec_us is not None:
-            self._update_fn_cost(fn_key, exec_us / 1e6)
+            self._update_fn_cost(fn_key, exec_us / 1e6, len(args_blob))
         if attribution.enabled:
             split = reply.pop("attr_exec", None)
             if split:
@@ -1996,7 +2171,7 @@ class ClusterRuntime:
                     == self.raylet_address
                     and not worker.get("chip_ids")):
                 ring_fut = await self._worker_ring_enqueue(
-                    spec, tmpl, worker)
+                    spec, tmpl, worker, sched_key=key)
             if ring_fut is not None:
                 # Pipelining: the lease recirculates once the entry is
                 # published, exactly like a wire push (see below).
@@ -2060,7 +2235,9 @@ class ClusterRuntime:
         # its way back under the inline threshold.
         exec_us = reply.get("exec_us") if isinstance(reply, dict) else None
         if exec_us is not None and spec.get("fn_key"):
-            self._update_fn_cost(spec["fn_key"], exec_us / 1e6)
+            args_blob = spec.get("args")
+            self._update_fn_cost(spec["fn_key"], exec_us / 1e6,
+                                 len(args_blob) if args_blob else None)
         self._record_task_reply(spec, reply)
         self._offer_worker(key, worker)
 
@@ -2114,6 +2291,12 @@ class ClusterRuntime:
                 "writer": writer, "reader": reader, "files": files,
                 "templates": {}, "next_tmpl": 0,
                 "waiters": {}, "client": client, "live": True,
+                # Round 16: producer-side ownership latch (caller tier
+                # <-> loop handoff) + templates the caller thread may
+                # reference (id(tmpl) -> (tmpl_id, strong tmpl ref),
+                # registration CONFIRMED — the caller must never ship
+                # a delta against an id still in flight).
+                "latch": ringmod.ProducerLatch(), "caller_tmpls": {},
             }
             # Reply fallback (full reply ring / oversized reply) rides
             # a server push on the worker connection; register before
@@ -2167,7 +2350,9 @@ class ClusterRuntime:
             self._worker_rings[wid] = False
 
     async def _worker_ring_enqueue(self, spec: dict, tmpl: SpecTemplate,
-                                   worker: dict) -> Optional[Any]:
+                                   worker: dict,
+                                   sched_key: Optional[str] = None
+                                   ) -> Optional[Any]:
         """Publish one template-spec delta on the leased worker's own
         ring; returns the reply future, or None when the entry cannot
         ride the ring (caller falls back to the RPC push)."""
@@ -2184,6 +2369,7 @@ class ClusterRuntime:
         if entry is None:
             if len(st["templates"]) >= 512:
                 st["templates"].clear()   # bounded; re-registers
+                st["caller_tmpls"].clear()
             tmpl_id = st["next_tmpl"]
             st["next_tmpl"] += 1
             reg = asyncio.get_running_loop().create_future()
@@ -2193,6 +2379,10 @@ class ClusterRuntime:
                                         template_id=tmpl_id,
                                         base=tmpl._base, timeout=10.0)
                 reg.set_result(True)
+                # Registration CONFIRMED: the caller tier may now ship
+                # deltas against this id (strong ref doubles as the
+                # id()-aliasing pin for the caller-side map).
+                st["caller_tmpls"][id(tmpl)] = (tmpl_id, tmpl)
             except Exception:
                 st["templates"].pop(id(tmpl), None)
                 reg.set_result(False)
@@ -2210,7 +2400,19 @@ class ClusterRuntime:
         payload = msgpack.packb(delta, use_bin_type=True)
         fut = asyncio.get_running_loop().create_future()
         st["waiters"][spec["task_id"]] = fut
-        if not st["writer"].push(payload):
+        # Caller dispatch on: this push contends the producer latch
+        # (the loop reclaims ring ownership for the fallback path).
+        # Flag off: no latch anywhere near the hot path — today's
+        # behavior, byte-identical.
+        latch = st["latch"] if self._caller_dispatch else None
+        if latch is not None:
+            latch.acquire("loop")
+        try:
+            pushed = st["writer"].push(payload)
+        finally:
+            if latch is not None:
+                latch.release()
+        if not pushed:
             # Full ring or oversized delta: not an error, just a miss.
             st["waiters"].pop(spec["task_id"], None)
             if attribution.enabled:
@@ -2220,32 +2422,52 @@ class ClusterRuntime:
             attribution.count("ring.direct_enq")
         if flight.enabled:
             flight.instant("ring", "direct_enq")
+        # A successful loop-path publish proves the whole flow works
+        # for this (sched_key, worker, template): advertise the pair
+        # to caller threads.
+        self._caller_ring_offer(sched_key, worker, st)
         return fut
 
     def _drain_worker_ring(self, st: dict) -> int:
-        if not st.get("live"):
-            return 0
-        try:
-            drained = st["reader"].drain()
-        except (OSError, ValueError):
-            return 0  # ring torn down under the callback
-        if drained:
-            # Doorbell-served drains must feed the backstop's pacing
-            # too ("activity", read-and-reset each backstop tick):
-            # otherwise active traffic served entirely by doorbells
-            # looks idle to the poll and it backs off to the idle
-            # period exactly when the lost-wakeup race matters.
-            st["activity"] = st.get("activity", 0) + len(drained)
-            if attribution.enabled:
+        from ray_tpu.core.ring import busy_poll
+
+        total = 0
+        rounds = 0
+        while st.get("live"):
+            try:
+                drained = st["reader"].drain()
+            except (OSError, ValueError):
+                break  # ring torn down under the callback
+            if drained and attribution.enabled:
                 # Counted HERE so ring.reply means exactly "replies
                 # that rode the twin ring" — fallback server pushes
                 # count under ring.reply_fallback instead (a full/
                 # broken reply ring must be visible in the counters).
                 attribution.count("ring.reply", len(drained))
-        for raw in drained:
-            self._worker_ring_complete(st,
-                                       msgpack.unpackb(raw, raw=False))
-        return len(drained)
+            for raw in drained:
+                self._worker_ring_complete(
+                    st, msgpack.unpackb(raw, raw=False))
+            total += len(drained)
+            # Busy-poll handoff (round 16, bounded): right after a
+            # non-empty drain the worker is mid-burst — spin briefly
+            # for the next reply instead of paying an epoll wakeup
+            # per batch. Never spins on an idle ring (drained empty).
+            if (not drained or self._busy_poll_s <= 0.0
+                    or rounds >= 2):
+                break
+            rounds += 1
+            if not busy_poll(st["reader"], self._busy_poll_s):
+                break
+            if attribution.enabled:
+                attribution.count("ring.busy_poll")
+        if total:
+            # Doorbell-served drains must feed the backstop's pacing
+            # too ("activity", read-and-reset each backstop tick):
+            # otherwise active traffic served entirely by doorbells
+            # looks idle to the poll and it backs off to the idle
+            # period exactly when the lost-wakeup race matters.
+            st["activity"] = st.get("activity", 0) + total
+        return total
 
     def _spawn_ring_task(self, coro) -> None:
         """ensure_future with a strong reference held until done (must
@@ -2268,7 +2490,15 @@ class ClusterRuntime:
         if not isinstance(msg, dict):
             return
         fut = st["waiters"].pop(msg.get("task_id"), None)
-        if fut is None or fut.done():
+        if fut is None:
+            return
+        if isinstance(fut, _CallerTask):
+            # Caller-enqueued entry: no parked coroutine to resume —
+            # finish the bookkeeping inline on the loop thread (this
+            # drain handles a whole batch per wakeup).
+            self._caller_task_complete(st, fut, msg)
+            return
+        if fut.done():
             return
         err = msg.get("error")
         if err is not None:
@@ -2278,12 +2508,223 @@ class ClusterRuntime:
                 # bound): drop OUR cache so the retry re-registers
                 # instead of re-sending the dead id forever.
                 st["templates"].clear()
+                st.get("caller_tmpls", {}).clear()
             # Same shape a failed wire push produces: the submit retry
             # loop treats it as a worker/transport fault.
             fut.set_exception(ConnectionLost(
                 f"ring dispatch failed: {err}"))
         else:
             fut.set_result(msg.get("reply"))
+
+    # -- caller-thread dispatch tier (round 16) ------------------------
+    def _caller_ring_offer(self, sched_key: Optional[str], worker: dict,
+                           st: dict) -> None:
+        """Advertise a (leased worker, live ring) pair to caller
+        threads under its scheduling key. Loop thread only, called
+        after a successful loop-path ring publish — by then the lease
+        is held, the pair is attached, and the template flow works.
+        Torn down in _teardown_worker_ring (single choke point)."""
+        if not self._caller_dispatch or sched_key is None:
+            return
+        with self._caller_lock:
+            self._caller_rings.setdefault(sched_key, {})[
+                worker["worker_id"]] = (worker, st)
+
+    def _caller_deps_ready(self, arg_oids) -> bool:
+        """Caller-thread analogue of _resolve_dependencies' ready
+        check: every OWNED top-level arg already has a value. A pending
+        dependency falls back to the loop path, whose resolver waits
+        properly (the caller thread must never block on upstream
+        tasks)."""
+        if not arg_oids:
+            return True
+        with self._owned_lock:
+            for oid in arg_oids:
+                entry = self._owned.get(oid)
+                if entry is not None and not entry.fut.done():
+                    return False
+        return True
+
+    def _try_caller_dispatch(self, spec: dict, refs: List[ObjectRef],
+                             pinned: Optional[List[ObjectID]],
+                             sched_key: str, tmpl: SpecTemplate) -> bool:
+        """Publish one submit from the caller thread onto a ringed
+        worker's forward ring (tier 5). True = published (the reply
+        drain finishes the task); False = miss, caller falls through
+        to _enqueue_submit with nothing consumed.
+
+        SPSC discipline: the push (and the waiter insert + liveness
+        re-check) run under the ring's ProducerLatch — the loop thread
+        cedes/reclaims the producer side through the same latch, so at
+        any instant the ring has exactly one producer."""
+        if self._shutdown:
+            return False
+        if not self._caller_deps_ready(spec.get("arg_oids") or ()):
+            return False
+        payload = None
+        w = None
+        deadline = None
+        while True:
+            # Pick a live, non-saturated ringed worker under this key.
+            # caller_pipeline < ring_slots is the in-flight bound: ring
+            # capacity bounds entries the WORKER hasn't dequeued, but
+            # only completions free caller_pipeline — without this cap
+            # a fast consumer would let the caller overrun the exec
+            # queue far past the loop path's pipeline discipline.
+            target = None
+            saw_ring = False
+            with self._caller_lock:
+                ringed = self._caller_rings.get(sched_key)
+                if ringed:
+                    for worker, st in ringed.values():
+                        if (not st.get("live") or worker.get("dead")
+                                or worker.get("returned")):
+                            continue
+                        saw_ring = True
+                        if (worker.get("caller_pipeline", 0)
+                                < self._ring_slots):
+                            target = (worker, st)
+                            break
+            if not saw_ring:
+                return False  # cold key: the loop path attaches/offers
+            if target is not None:
+                worker, st = target
+                entry = st.get("caller_tmpls", {}).get(id(tmpl))
+                if entry is None:
+                    # Template not registered on this ring yet: one
+                    # loop-path submission registers it and re-offers.
+                    return False
+                if payload is None:
+                    delta = {"t": entry[0], "task_id": spec["task_id"],
+                             "args": spec["args"],
+                             "arg_oids": spec.get("arg_oids") or [],
+                             "trace_ctx": spec.get("trace_ctx")}
+                    payload = msgpack.packb(delta, use_bin_type=True)
+                    w = _CallerTask(spec, refs, pinned, sched_key, tmpl,
+                                    worker, spec.get("fn_key"),
+                                    len(spec["args"]), time.monotonic())
+                w.worker = worker
+                latch = st["latch"]
+                latch.acquire("caller")
+                try:
+                    if (st.get("live") and not worker.get("dead")
+                            and not worker.get("returned")):
+                        # Waiter + pipeline count BEFORE push (loop-
+                        # path order): the worker can reply before
+                        # this thread runs another bytecode — a reply
+                        # with no waiter is dropped on the floor, and
+                        # a completion decrementing before our
+                        # increment would leave a phantom in-flight
+                        # count pinning the lease.
+                        st["waiters"][spec["task_id"]] = w
+                        self._inflight_task_workers[spec["task_id"]] = (
+                            worker["worker_address"], False)
+                        with self._caller_lock:
+                            worker["caller_pipeline"] = (
+                                worker.get("caller_pipeline", 0) + 1)
+                        if st["writer"].push(payload):
+                            break
+                        st["waiters"].pop(spec["task_id"], None)
+                        self._inflight_task_workers.pop(
+                            spec["task_id"], None)
+                        with self._caller_lock:
+                            worker["caller_pipeline"] = max(
+                                0,
+                                worker.get("caller_pipeline", 1) - 1)
+                finally:
+                    latch.release()
+            # Saturated pipeline or full ring. Slots and pipeline
+            # window free at the worker's service rate, so a bounded
+            # wait rides out a burst overrun instead of dumping the
+            # overflow onto the loop-hop path (which would put the
+            # loop right back on the hot path this tier exists to
+            # skip). The sleep yields the GIL, letting the loop
+            # thread drain completions meanwhile.
+            now = time.monotonic()
+            if deadline is None:
+                deadline = now + self._caller_push_wait_s
+            if now >= deadline:
+                if attribution.enabled:
+                    attribution.count("submit.caller_fallback")
+                if flight.enabled:
+                    flight.instant("task", "caller_fallback")
+                return False
+            time.sleep(0.0002)
+        if attribution.enabled:
+            attribution.count("submit.caller_enq")
+        if flight.enabled:
+            flight.instant("task", "caller_enq", arg=spec.get("name"))
+        self._note_caller_pressure()
+        return True
+
+    def _caller_task_complete(self, st: dict, w: _CallerTask,
+                              msg: dict) -> None:
+        """Completion bookkeeping for one caller-enqueued task — the
+        loop-path epilogue of _run_on_leased_worker, minus the lease
+        recirculation (the caller tier never acquired the worker; the
+        loop path owns its circulation). Runs on the loop thread,
+        batched N per reply-ring drain."""
+        with self._caller_lock:
+            w.worker["caller_pipeline"] = max(
+                0, w.worker.get("caller_pipeline", 1) - 1)
+        self._inflight_task_workers.pop(w.spec["task_id"], None)
+        err = msg.get("error")
+        if err is not None:
+            if "unknown spec template" in err:
+                st["templates"].clear()
+                st.get("caller_tmpls", {}).clear()
+            self._caller_task_retry(
+                w, ConnectionLost(f"ring dispatch failed: {err}"))
+            return
+        self._cancel_requested.discard(w.spec["task_id"])
+        reply = msg.get("reply")
+        rtt = time.monotonic() - w.push_t0
+        prev = w.worker.get("svc_ema")
+        w.worker["svc_ema"] = (rtt if prev is None
+                               else 0.7 * prev + 0.3 * rtt)
+        if attribution.enabled:
+            attribution.record("submit.caller_rtt", rtt)
+        exec_us = (reply.get("exec_us")
+                   if isinstance(reply, dict) else None)
+        if exec_us is not None and w.fn_key:
+            self._update_fn_cost(w.fn_key, exec_us / 1e6, w.args_len)
+        self._record_task_reply(w.spec, reply)
+        if w.pinned:
+            self._unpin_args(w.pinned)
+
+    def _caller_task_retry(self, w: _CallerTask, exc: Exception) -> None:
+        """Route a failed caller-enqueued entry onto the SAME typed
+        retry path a failed RPC push takes — minus the attempt this
+        enqueue consumed. Loop thread only."""
+        spec, refs = w.spec, w.refs
+        if spec["task_id"] in self._cancel_requested:
+            self._fail_task_cancelled(spec, refs)
+            if w.pinned:
+                self._unpin_args(w.pinned)
+            return
+        retries = spec.get("max_retries", 0)
+        if retries < 1 or self._shutdown:
+            self._fail_task(spec, refs,
+                            f"worker died ({exc}); retries exhausted")
+            if w.pinned:
+                self._unpin_args(w.pinned)
+            return
+        # max_retries is decremented on the RESUBMITTED spec: this
+        # enqueue was attempt #1. Workers ignore the field at
+        # execution, so the mutation is wire-safe.
+        respec = dict(spec, max_retries=retries - 1)
+        self._spawn_ring_task(self._submit_async(
+            respec, refs, w.pinned, sched_key=w.sched_key, tmpl=w.tmpl))
+
+    def _caller_task_abandon(self, w: _CallerTask, why: str) -> None:
+        """Ring died/detached with this caller entry possibly in
+        flight: undo the in-flight accounting and send it to the retry
+        path (parity with the ConnectionLost future waiters sweep)."""
+        with self._caller_lock:
+            w.worker["caller_pipeline"] = max(
+                0, w.worker.get("caller_pipeline", 1) - 1)
+        self._inflight_task_workers.pop(w.spec["task_id"], None)
+        self._caller_task_retry(w, ConnectionLost(why))
 
     async def _worker_ring_backstop(self, st: dict) -> None:
         """Adaptive lost-wakeup backstop (ring.AdaptivePoll: base
@@ -2312,12 +2753,36 @@ class ClusterRuntime:
         in flight: fail every waiter with ConnectionLost — the submit
         retry loop treats that exactly like a failed RPC push (lease
         marked dead, task re-leased elsewhere) — and retire the pair,
-        pinning this worker_id to the RPC path."""
-        waiters, st["waiters"] = st["waiters"], {}
+        pinning this worker_id to the RPC path. Caller-enqueued
+        waiters take the same typed path through their own resubmit
+        (handoff-reclaim: the teardown owns the producer side from
+        here on; a caller that raced us re-checks `live` under the
+        latch and misses)."""
+        waiters = self._sweep_ring_waiters(st)
         for fut in waiters.values():
-            if not fut.done():
+            if isinstance(fut, _CallerTask):
+                self._caller_task_abandon(fut, why)
+            elif not fut.done():
                 fut.set_exception(ConnectionLost(why))
         self._teardown_worker_ring(st, latch_failed=True)
+
+    def _sweep_ring_waiters(self, st: dict) -> dict:
+        """Swap out the waiter map for a teardown sweep. With caller
+        dispatch on, the swap AND the live flip happen under the
+        ProducerLatch (as the terminal owner): a caller-thread insert
+        is either fully in the swapped-out map or sees live=False and
+        falls back — never stranded in the replacement dict."""
+        latch = st.get("latch") if self._caller_dispatch else None
+        if latch is None:
+            waiters, st["waiters"] = st["waiters"], {}
+            return waiters
+        latch.acquire("teardown")
+        try:
+            st["live"] = False
+            waiters, st["waiters"] = st["waiters"], {}
+            return waiters
+        finally:
+            latch.release()
 
     async def _detach_worker_ring(self, st: dict) -> None:
         """Lease return detaches and destroys the pair: tell the
@@ -2335,9 +2800,13 @@ class ClusterRuntime:
         # still pending after that can only mean lost work — fail it
         # onto the retry path rather than hang its get() forever.
         self._drain_worker_ring(st)
-        waiters, st["waiters"] = st["waiters"], {}
+        waiters = self._sweep_ring_waiters(st)
         for fut in waiters.values():
-            if not fut.done():
+            if isinstance(fut, _CallerTask):
+                self._caller_task_abandon(
+                    fut, "lease returned with ring submissions in "
+                         "flight")
+            elif not fut.done():
                 fut.set_exception(ConnectionLost(
                     "lease returned with ring submissions in flight"))
         try:
@@ -2351,10 +2820,22 @@ class ClusterRuntime:
         """Close + destroy one driver-side pair (we own the files).
         latch_failed=True pins the worker_id to the RPC path (dead
         worker); False forgets it, so re-leasing the same live worker
-        attaches a fresh pair."""
-        if not st.get("live"):
+        attaches a fresh pair. Idempotence keys on `torn`, not `live`:
+        the caller-dispatch waiter sweep flips live early (under the
+        latch) and the teardown must still run once after it."""
+        if st.get("torn"):
             return
+        st["torn"] = True
         st["live"] = False
+        # Single choke point for the caller-dispatch registry: no
+        # caller thread may target a ring past its teardown.
+        if self._caller_dispatch:
+            with self._caller_lock:
+                for key in list(self._caller_rings):
+                    ringed = self._caller_rings[key]
+                    ringed.pop(st["worker_id"], None)
+                    if not ringed:
+                        del self._caller_rings[key]
         backstop = st.get("backstop")
         if backstop is not None:
             try:
@@ -2581,7 +3062,10 @@ class ClusterRuntime:
         spillback handle the parallelism instead."""
         if worker.get("dead") or worker.get("avail"):
             return
-        pipeline = worker.get("pipeline", 0)
+        # Caller-enqueued entries occupy the same execution queue as
+        # loop-path pushes; both count against the pipeline window.
+        pipeline = (worker.get("pipeline", 0)
+                    + worker.get("caller_pipeline", 0))
         if pipeline >= self._pipeline_depth:
             return
         if pipeline > 0:
@@ -2612,7 +3096,9 @@ class ClusterRuntime:
         raylet can reschedule its resources."""
         await asyncio.sleep(ray_config().lease_idle_linger_s)
         lingered = 0.0
-        while worker in pool.idle and worker.get("pipeline", 0) > 0:
+        while worker in pool.idle and (
+                worker.get("pipeline", 0) > 0
+                or worker.get("caller_pipeline", 0) > 0):
             # Pipelined pushes still executing: the lease cannot be
             # returned yet. Ring-published entries hold the same
             # pipeline counter, so a ring-attached lease with in-flight
@@ -2738,7 +3224,8 @@ class ClusterRuntime:
                     except ValueError:
                         pass
                     continue
-                if worker.get("pipeline", 0) > 0:
+                if (worker.get("pipeline", 0) > 0
+                        or worker.get("caller_pipeline", 0) > 0):
                     # Push(es) in flight: healthy — unless one has been
                     # outstanding implausibly long; then report the
                     # connection state so wedges are diagnosable.
@@ -4133,23 +4620,44 @@ class ClusterRuntime:
         return True
 
     def _on_task_ring_doorbell(self, state: dict) -> int:
-        try:
-            drained = state["reader"].drain()
-        except (OSError, ValueError):
-            return 0  # ring torn down under the callback
-        if drained:
-            # Feed the backstop's pacing (see _drain_worker_ring).
-            state["activity"] = state.get("activity", 0) + len(drained)
-        for raw in drained:
+        from ray_tpu.core.ring import busy_poll
+
+        total = 0
+        rounds = 0
+        while True:
             try:
-                self._submit_ring_task(state, raw)
-            except Exception:
-                # One malformed entry must not drop the REST of the
-                # drained batch on the floor (their waiters would hang
-                # with the worker still connected).
-                logger.warning("malformed ring entry dropped",
-                               exc_info=True)
-        return len(drained)
+                drained = state["reader"].drain()
+            except (OSError, ValueError):
+                return total  # ring torn down under the callback
+            for raw in drained:
+                try:
+                    self._submit_ring_task(state, raw)
+                except Exception:
+                    # One malformed entry must not drop the REST of
+                    # the drained batch on the floor (their waiters
+                    # would hang with the worker still connected).
+                    logger.warning("malformed ring entry dropped",
+                                   exc_info=True)
+            total += len(drained)
+            # Busy-poll handoff (round 16, ROADMAP 3c): mid-burst the
+            # driver's next delta lands within the spin budget — take
+            # it now instead of sleeping into an epoll wakeup. Gated
+            # on traffic (this drain found entries) so an idle worker
+            # core never spins.
+            if (not drained or self._busy_poll_s <= 0.0
+                    or rounds >= 2):
+                break
+            rounds += 1
+            if not busy_poll(state["reader"], self._busy_poll_s):
+                break
+            if attribution.enabled:
+                attribution.count("worker.busy_poll")
+            if flight.enabled:
+                flight.instant("ring", "busy_poll")
+        if total:
+            # Feed the backstop's pacing (see _drain_worker_ring).
+            state["activity"] = state.get("activity", 0) + total
+        return total
 
     async def _task_ring_backstop(self, state: dict) -> None:
         """Lost-wakeup backstop, adaptively paced (ring.AdaptivePoll):

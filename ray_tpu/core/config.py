@@ -259,6 +259,58 @@ _D("lease_return_batching", True,
    "round-8 grant batch, coalesced through the same deferred-pump "
    "discipline). Disabling restores one return_worker RPC per lease.")
 
+# -- caller-thread dispatch tier (round 16) ------------------------------
+_D("task_caller_dispatch", True,
+   "Caller-thread ring dispatch (round 16, the fifth dispatch tier): "
+   "when a submit is ring-eligible against an already-leased, "
+   "already-ringed worker whose spec template is registered, the "
+   "CALLER thread encodes the template delta and publishes it onto "
+   "the worker's forward ring directly — no loop wakeup, no "
+   "coroutine. The SPSC single-producer invariant holds through ring "
+   "ownership handoff (ring.ProducerLatch): the loop thread cedes a "
+   "ring's producer side to the caller under the latch and reclaims "
+   "it for fallback/teardown. Any miss (no ringed worker, template "
+   "unregistered, unresolved deps, full ring past the bounded wait) "
+   "falls through to the loop-hop submit queue byte-identically. "
+   "Only meaningful with submit_ring on; disabling restores the "
+   "round-10 loop-hop path exactly (the latch is never even taken).")
+_D("caller_push_wait_ms", 5.0,
+   "Bounded backpressure wait of a caller-thread enqueue against a "
+   "FULL forward ring with completions in flight: slots free at the "
+   "worker's service rate, so a short wait rides out a burst "
+   "overrun instead of dumping the overflow onto the loop-hop path. "
+   "Past the budget the submit falls back (counted under "
+   "submit.caller_fallback). 0 = fall back immediately.")
+_D("ring_busy_poll_us", 100,
+   "Busy-poll handoff budget for ring consumers, in microseconds "
+   "(round 16, ROADMAP 3c): after a non-empty drain the consumer "
+   "spins up to this long for the next entry before handing back to "
+   "epoll — under sustained traffic the producer's next publish "
+   "lands inside the spin window and the dequeue side never pays "
+   "the epoll-wakeup/OS-scheduling latency. Only engaged while "
+   "traffic is flowing (a drain that found entries), so an idle "
+   "ring costs nothing. 0 disables the spin entirely.")
+_D("inline_cost_model_v2", True,
+   "Arg-size-conditional inline cost model (round 16, ROADMAP 3b): "
+   "per-fn exec EMAs are keyed by (fn, arg-size bucket) so a "
+   "function that is tiny on small args but slow on big ones "
+   "inlines exactly its small-arg shapes; an unknown bucket "
+   "inherits eligibility downward from a known-tiny LARGER bucket "
+   "(bigger args observed cheap implies smaller args are). "
+   "Inlining also becomes scheduler-revocable under caller-thread "
+   "dispatch pressure (see inline_revoke_pressure): when the caller "
+   "thread is the ring producer for a hot burst, stealing it for "
+   "inline execution starves the dispatch tier that keeps every "
+   "worker fed. Disabling restores the round-8 single-scalar EMA.")
+_D("inline_revoke_pressure", 200,
+   "Caller-thread enqueues within one revoke window that revoke the "
+   "inline tier (pressure signal: the caller thread is busy being a "
+   "ring producer). Revocation lasts one window and re-arms while "
+   "the pressure sustains; remote dispatch serves the revoked calls.")
+_D("inline_revoke_window_ms", 100.0,
+   "Sliding window (and revocation duration) for the caller-pressure "
+   "inline revocation, in milliseconds.")
+
 # -- flight recorder (round 12 observability) ----------------------------
 _D("flight_recorder", True,
    "Per-process flight recorder (core/flight.py): a fixed-capacity "

@@ -49,10 +49,15 @@ when one hit. This module is that capability:
    a bench burst and writes the file).
 
 Event categories in the tree today: ``task`` (submit tiers, push RTT,
-worker exec), ``lease`` (acquire wait / return), ``ring`` (SPSC
-enq/deq/doorbell traffic), ``gc`` (collector pauses), ``loop``
-(heartbeat scheduling delays), ``stall`` (finalized episodes),
-``engine`` (serve decode/prefill steps).
+worker exec; round 16 adds ``caller_enq``/``caller_fallback`` instants
+for the caller-thread dispatch tier and ``inline_revoked`` for the
+cost-model-v2 pressure gate), ``lease`` (acquire wait / return),
+``ring`` (SPSC enq/deq/doorbell traffic; round 16 adds ``handoff``
+producer-ownership migrations, ``busy_poll`` spin windows, and the
+raylet-side ``pin``/``unpin`` instants bracketing a worker's
+ring-attached span), ``gc`` (collector pauses), ``loop`` (heartbeat
+scheduling delays), ``stall`` (finalized episodes), ``engine`` (serve
+decode/prefill steps).
 """
 
 from __future__ import annotations

@@ -1539,6 +1539,14 @@ class Raylet(NodeLedger):
         w = self._workers.get(worker_id)
         if w is not None:
             w.ring_attached = True
+            # Pin/unpin instants bracket the worker's ring-attached
+            # span in the merged timeline: a worker that stays pinned
+            # after its lease returned (leak) or ping-pongs pin/unpin
+            # per burst (churn) is visible at a glance.
+            from ray_tpu.core import flight
+
+            if flight.enabled:
+                flight.instant("ring", "pin", arg=worker_id[:8])
         return True
 
     async def handle_worker_ring_detached(self, conn: ServerConnection, *,
@@ -1546,6 +1554,10 @@ class Raylet(NodeLedger):
         w = self._workers.get(worker_id)
         if w is not None:
             w.ring_attached = False
+            from ray_tpu.core import flight
+
+            if flight.enabled:
+                flight.instant("ring", "unpin", arg=worker_id[:8])
         return True
 
     async def handle_mark_actor_worker(self, conn: ServerConnection, *,

@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import os
 import struct
+import threading
 import time
 from multiprocessing import shared_memory
 from typing import List, Optional, Tuple
@@ -110,6 +111,84 @@ class AdaptivePoll:
             self._idle_polls = 0
         else:
             self._idle_polls += 1
+
+
+class ProducerLatch:
+    """Ownership handoff for a ring's producer side (round 16).
+
+    The ring is SPSC: ONE producer may write tail. Caller-thread
+    dispatch wants the submitting thread to push directly, but the
+    driver loop thread still pushes on fallback paths and must reclaim
+    the producer side for teardown. The latch serializes those roles:
+    every push runs under `acquire(who)` / `release()`, and an owner
+    change is an observable *handoff* (`ring.handoff` attribution +
+    flight instant). The SPSC invariant is thus preserved by mutual
+    exclusion — at any instant exactly one thread holds the producer
+    side — while the handoff count keeps the tier honest about how
+    often ownership actually migrates (a ping-ponging latch would eat
+    the caller tier's win).
+
+    Not a hot-path tax for flag-off deployments: the loop path only
+    takes the latch when caller dispatch is enabled.
+    """
+
+    __slots__ = ("_lock", "_owner", "handoffs")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._owner: Optional[str] = None
+        self.handoffs = 0
+
+    @property
+    def owner(self) -> Optional[str]:
+        return self._owner
+
+    def acquire(self, who: str) -> None:
+        self._lock.acquire()
+        if self._owner != who:
+            if self._owner is not None:
+                self.handoffs += 1
+                if attribution.enabled:
+                    attribution.count("ring.handoff")
+                if flight.enabled:
+                    flight.instant("ring", "handoff",
+                                   {"from": self._owner, "to": who})
+            self._owner = who
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self):  # pragma: no cover - convenience only
+        self.acquire("anon")
+        return self
+
+    def __exit__(self, *exc):  # pragma: no cover - convenience only
+        self.release()
+
+
+def busy_poll(end: "_Ring", budget_s: float) -> bool:
+    """Spin on the ring cursors for up to `budget_s` waiting for it to
+    turn non-empty (round 16 busy-poll handoff, ROADMAP 3c). Returns
+    True the moment `tail != head`; False when the budget expires or
+    the ring closed. Pure userspace loads — no syscalls — so the spin
+    window hides exactly the epoll-wakeup latency it replaces. Callers
+    gate it on traffic (only spin right after a non-empty drain) so an
+    idle ring never burns a core."""
+    if budget_s <= 0.0:
+        return end.tail != end.head
+    deadline = time.perf_counter() + budget_s
+    spun = False
+    while True:
+        if end.closed:
+            return False
+        if end.tail != end.head:
+            if spun:
+                if attribution.enabled:
+                    attribution.count("ring.busy_poll_hit")
+            return True
+        spun = True
+        if time.perf_counter() >= deadline:
+            return False
 
 
 def ring_bytes(nslots: int, slot_bytes: int) -> int:
@@ -207,6 +286,11 @@ class RingWriter(_Ring):
     def __init__(self, name: str, fifo: str):
         super().__init__(name, fifo)
         self._fifo_fd: Optional[int] = None
+        # Honesty sentinel for the SPSC invariant: pushes overlapping in
+        # time mean two producers raced past the ProducerLatch
+        # discipline. Checked by the round-16 perf guard (must be 0).
+        self._in_push = False
+        self.producer_violations = 0
 
     def _doorbell(self) -> None:
         if self._fifo_fd is None:
@@ -230,15 +314,26 @@ class RingWriter(_Ring):
         n = len(payload)
         if n > self.slot_bytes or self.closed:
             return False
-        head, tail = self.head, self.tail
-        if tail - head >= self.nslots:
-            return False  # full: overflow is the caller's fallback
-        off = self._slot_off(tail)
-        _LEN.pack_into(self.buf, off, n)
-        self.buf[off + _LEN.size:off + _LEN.size + n] = payload
-        # Publish AFTER the payload lands: the consumer never reads past
-        # tail, so a half-written slot is unreachable.
-        self.tail = tail + 1
+        if self._in_push:
+            # Concurrent producer detected: the latch discipline was
+            # violated. Count it (the perf guard asserts zero) but do
+            # not crash the task plane over an observability check.
+            self.producer_violations += 1
+            if attribution.enabled:
+                attribution.count("ring.producer_violation")
+        self._in_push = True
+        try:
+            head, tail = self.head, self.tail
+            if tail - head >= self.nslots:
+                return False  # full: overflow is the caller's fallback
+            off = self._slot_off(tail)
+            _LEN.pack_into(self.buf, off, n)
+            self.buf[off + _LEN.size:off + _LEN.size + n] = payload
+            # Publish AFTER the payload lands: the consumer never reads
+            # past tail, so a half-written slot is unreachable.
+            self.tail = tail + 1
+        finally:
+            self._in_push = False
         if attribution.enabled:
             attribution.count("ring.enq")
         if flight.enabled:

@@ -8,7 +8,10 @@ reference budgets at 50-300 µs/task (SURVEY §3.2). Run directly:
     python -m ray_tpu.perf --attribute # + submit-path breakdown
     python -m ray_tpu.perf --ring      # worker-direct dispatch rings
                                        # (tasks_ring_per_s + honesty
-                                       # counters, round 10)
+                                       # counters, round 10; round 16
+                                       # adds the caller-thread phase:
+                                       # tasks_ring_caller_per_s vs the
+                                       # loop-hop rate, same cluster)
     python -m ray_tpu.perf --timeline [FILE]
                                        # flight-recorder capture: task
                                        # burst -> merged driver+worker
@@ -280,10 +283,26 @@ def run_ring_microbench(scale: float = 1.0,
     over the twin ring, and fallbacks (zero on the happy path).
     Fold-best of `rounds` bursts, same convention as the perf guards.
 
+    Round 16 runs the SAME cluster through two phases so the caller-
+    thread tier is compared against the loop-hop ring path with every
+    box-noise variable held constant: phase 1 flips the caller tier
+    off on the live runtime (the flag is read per-submit on the caller
+    thread, nothing is cached), phase 2 flips it back on, warms the
+    caller registry (offers only happen on loop-path publishes with
+    the flag up), and measures the caller-enqueue burst plus its own
+    honesty counters: caller enqueues vs loop-hop fallbacks (the <5%
+    bound), ProducerLatch handoffs, and SPSC producer violations
+    (must be 0 — both the attribution counter and the writers' own
+    re-entrancy sentinels are reported).
+
     Returns:
-      tasks_ring_per_s  : remote tiny-task rate over the rings
-      ring_enq / ring_doorbell / ring_reply / ring_fallback : counters
-      ring_engaged      : at least one live driver<->worker pair
+      tasks_ring_per_s        : loop-hop remote tiny-task rate
+      tasks_ring_caller_per_s : caller-thread-enqueue rate, same ring
+      ring_caller_vs_loop     : the caller-tier win (ratio of the two)
+      ring_enq / ring_doorbell / ring_reply / ring_fallback : phase-1
+      caller_enq / caller_fallback / caller_handoffs /
+      caller_violations       : phase-2 honesty counters
+      ring_engaged / caller_engaged : tier actually exercised
     """
     import os
 
@@ -297,13 +316,18 @@ def run_ring_microbench(scale: float = 1.0,
     attribution.enable()
     ncpu = min(4, max(2, os.cpu_count() or 1))
     ray_tpu.init(num_cpus=ncpu, _system_config={
-        "submit_ring": True, "task_inline_execution": False})
+        "submit_ring": True, "task_inline_execution": False,
+        "task_caller_dispatch": True})
     out: Dict[str, Any] = {}
     try:
+        rt = ray_tpu.core.worker.current_runtime()
         noop = ray_tpu.remote(_noop)
         ray_tpu.get([noop.remote() for _ in range(10)], timeout=120)
-        attribution.reset()
         n = max(1, int(1000 * scale))
+
+        # -- phase 1: loop-hop ring path (caller tier off) -------------
+        rt._caller_dispatch = False
+        attribution.reset()
         best = 0.0
         for _ in range(max(1, rounds)):
             t0 = time.perf_counter()
@@ -316,10 +340,41 @@ def run_ring_microbench(scale: float = 1.0,
                            ("ring.reply", "ring_reply"),
                            ("ring.fallback", "ring_fallback")):
             out[key] = snap.get(label, {}).get("count", 0)
-        rt = ray_tpu.core.worker.current_runtime()
         out["ring_engaged"] = any(
             isinstance(st, dict) and st.get("live")
             for st in rt._worker_rings.values())
+
+        # -- phase 2: caller-thread enqueue, same cluster same rings ---
+        rt._caller_dispatch = True
+        # Warm burst populates _caller_rings (registry offers ride
+        # loop-path publishes) so the measured bursts hit the tier.
+        ray_tpu.get([noop.remote() for _ in range(min(n, 100))],
+                    timeout=300)
+        attribution.reset()
+        best = 0.0
+        for _ in range(max(1, rounds)):
+            t0 = time.perf_counter()
+            ray_tpu.get([noop.remote() for _ in range(n)], timeout=300)
+            best = max(best, n / (time.perf_counter() - t0))
+        out["tasks_ring_caller_per_s"] = round(best, 1)
+        snap = attribution.snapshot()
+        for label, key in (("submit.caller_enq", "caller_enq"),
+                           ("submit.caller_fallback", "caller_fallback"),
+                           ("ring.handoff", "caller_handoffs"),
+                           ("ring.producer_violation",
+                            "caller_violations")):
+            out[key] = snap.get(label, {}).get("count", 0)
+        # The writers' own re-entrancy sentinels, independent of the
+        # attribution plumbing: a violation that raced past a count
+        # still shows here.
+        out["caller_violations"] += sum(
+            getattr(st.get("writer"), "producer_violations", 0)
+            for st in rt._worker_rings.values()
+            if isinstance(st, dict))
+        out["caller_engaged"] = out["caller_enq"] > 0
+        out["ring_caller_vs_loop"] = round(
+            out["tasks_ring_caller_per_s"]
+            / max(out["tasks_ring_per_s"], 1e-9), 2)
     finally:
         ray_tpu.shutdown()
         if not prev_attr:
@@ -782,7 +837,10 @@ def main() -> None:
                    help="run ONLY the worker-direct dispatch-ring "
                         "bench (boots a ring-enabled cluster, measures "
                         "tasks_ring_per_s + the enqueue/doorbell/"
-                        "fallback honesty counters)")
+                        "fallback honesty counters, then the caller-"
+                        "thread phase: tasks_ring_caller_per_s + "
+                        "caller enqueue/fallback/handoff/violation "
+                        "counters on the same cluster)")
     p.add_argument("--timeline", nargs="?", const="ray_tpu_timeline.json",
                    default=None, metavar="FILE",
                    help="bracket a task burst with the flight recorder "

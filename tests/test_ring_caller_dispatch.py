@@ -1,0 +1,184 @@
+"""Cluster integration: round-16 caller-thread dispatch tier.
+
+The fifth dispatch tier — ring-eligible submits against an already
+leased, already ringed worker are encoded and pushed by the CALLER
+thread under the ProducerLatch, no loop wakeup — pinned at its
+lifecycle edges: the tier engages and returns byte-identical results
+(including multi-return), the SPSC invariant holds under a real
+caller-vs-loop producer mix (writer sentinels stay 0), a worker
+SIGKILLed with caller-pushed entries in flight drains to the
+ConnectionLost retry path with exactly-once submission accounting,
+and flag-off restores the loop-hop ring path untouched.
+
+One module-scoped caller-dispatch cluster serves the first tests
+(ordered so the worker-kill chaos runs last on it); flag-off boots
+its own.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.config import ray_config
+
+pytestmark = pytest.mark.cluster
+
+
+def _live_rings(rt):
+    return [st for st in rt._worker_rings.values()
+            if isinstance(st, dict) and st.get("live")]
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _restore_config():
+    saved = dict(ray_config()._values)
+    yield
+    ray_config()._values.clear()
+    ray_config()._values.update(saved)
+
+
+@pytest.fixture(scope="module")
+def caller_cluster(_restore_config):
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, _system_config={
+        "submit_ring": True, "task_inline_execution": False,
+        "task_caller_dispatch": True, "task_retry_delay_ms": 50})
+    yield ray_tpu.core.worker.current_runtime()
+    ray_tpu.shutdown()
+
+
+def test_caller_tier_engages_with_result_parity(caller_cluster):
+    """A warmed burst must route through the caller tier (registry
+    populated by the warm burst's loop-path publishes) and return the
+    same values the loop path would — and the writers' SPSC sentinels
+    must stay at zero with the caller and loop threads sharing the
+    producer side through the latch."""
+    from ray_tpu.core import attribution
+
+    rt = caller_cluster
+
+    @ray_tpu.remote
+    def add(x):
+        return x + 1
+
+    ray_tpu.get([add.remote(i) for i in range(50)], timeout=120)
+    assert _live_rings(rt), rt._worker_rings
+    attribution.enable()
+    attribution.reset()
+    try:
+        assert ray_tpu.get([add.remote(i) for i in range(300)],
+                           timeout=180) == [i + 1 for i in range(300)]
+        snap = attribution.snapshot()
+        enq = snap.get("submit.caller_enq", {}).get("count", 0)
+        assert enq > 0, snap
+        assert snap.get("ring.producer_violation",
+                        {}).get("count", 0) == 0, snap
+        # Caller round trips are timed, one per completion.
+        assert snap.get("submit.caller_rtt",
+                        {}).get("count", 0) > 0, snap
+    finally:
+        attribution.disable()
+    assert all(st["writer"].producer_violations == 0
+               for st in _live_rings(rt))
+
+
+def test_multi_return_rides_the_caller_tier(caller_cluster):
+    """num_returns > 1 is ring-eligible: the caller tier must hand back
+    the same ref tuple shape and values as every other tier."""
+
+    @ray_tpu.remote(num_returns=2)
+    def pair(x):
+        return x, x * 10
+
+    ray_tpu.get(pair.remote(0), timeout=120)  # warm the template
+    for i in range(20):
+        a, b = pair.remote(i)
+        assert ray_tpu.get([a, b], timeout=60) == [i, i * 10]
+
+
+def test_worker_kill_mid_caller_burst_retries(caller_cluster):
+    """Handoff-reclaim chaos (runs last on the shared cluster): SIGKILL
+    a worker with caller-pushed entries in flight. The teardown sweep
+    takes the latch as "teardown", reclaims the producer side, and
+    every caller-tracked waiter must fail onto the ConnectionLost
+    retry path and complete elsewhere — no loss, no duplication."""
+    rt = caller_cluster
+
+    @ray_tpu.remote
+    def pid_add(x):
+        return (os.getpid(), x + 1)
+
+    warm = ray_tpu.get([pid_add.remote(i) for i in range(40)],
+                       timeout=120)
+    pids = sorted({p for p, _ in warm})
+    assert _live_rings(rt), rt._worker_rings
+
+    refs = [pid_add.remote(i) for i in range(200)]
+    time.sleep(0.05)          # let part of the burst go in flight
+    os.kill(pids[0], signal.SIGKILL)
+    res = ray_tpu.get(refs, timeout=180)
+    assert [x for _, x in res] == [i + 1 for i in range(200)]
+
+    # Exactly-once submission accounting survives the chaos: the
+    # caller-tier retry re-EXECUTES through _submit_async, it never
+    # re-SUBMITs (one SUBMITTED event per task).
+    task_ids = {r.id().task_id().hex() for r in refs}
+    deadline = time.monotonic() + 15
+    counts = {}
+    while time.monotonic() < deadline:
+        counts = {}
+        for e in rt.task_events():
+            if (e.get("task_id") in task_ids
+                    and e.get("event") == "SUBMITTED"):
+                counts[e["task_id"]] = counts.get(e["task_id"], 0) + 1
+        if len(counts) == len(task_ids):
+            break
+        time.sleep(0.5)
+    assert len(counts) == len(task_ids)
+    assert all(n == 1 for n in counts.values()), {
+        t: n for t, n in counts.items() if n != 1}
+
+
+def test_flag_off_restores_loop_hop_ring_path():
+    """task_caller_dispatch=False with rings on: the loop-hop ring path
+    of round 10, byte-identically — zero caller enqueues, zero latch
+    traffic, direct enqueues still flowing."""
+    from ray_tpu.core import attribution
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, _system_config={
+        "submit_ring": True, "task_inline_execution": False,
+        "task_caller_dispatch": False})
+    try:
+        rt = ray_tpu.core.worker.current_runtime()
+        assert rt._caller_dispatch is False
+
+        @ray_tpu.remote
+        def dbl(x):
+            return x * 2
+
+        ray_tpu.get([dbl.remote(i) for i in range(30)], timeout=120)
+        attribution.enable()
+        attribution.reset()
+        try:
+            assert ray_tpu.get([dbl.remote(i) for i in range(100)],
+                               timeout=120) == [
+                i * 2 for i in range(100)]
+            snap = attribution.snapshot()
+            assert snap.get("submit.caller_enq",
+                            {}).get("count", 0) == 0, snap
+            assert snap.get("ring.handoff",
+                            {}).get("count", 0) == 0, snap
+            assert snap.get("ring.direct_enq",
+                            {}).get("count", 0) > 0, snap
+        finally:
+            attribution.disable()
+        # The caller registry never populates with the flag down.
+        assert rt._caller_rings == {}
+        for st in _live_rings(rt):
+            assert st["latch"].owner is None
+    finally:
+        ray_tpu.shutdown()
