@@ -50,6 +50,25 @@ class ScalingConfig:
 
 
 @dataclass
+class TrainConfig:
+    """Training-loop instrumentation knobs (round 17 observability).
+
+    profile_steps: capture a jax.profiler trace on every worker for
+        steps [a, b] (1-indexed, inclusive): the trace starts when step
+        a begins and stops after step b completes. Each worker writes
+        its trace under `profile_dir/<trial>/rank<k>` and publishes the
+        location to GCS KV (`train_profile/<trial>/<rank>`), surfaced at
+        GET /api/train/profile and folded into /api/train. Open the dir
+        with TensorBoard's profile plugin or xprof.
+    profile_dir: base directory for trace output (default
+        /tmp/ray_tpu_profile on the worker's node).
+    """
+
+    profile_steps: Optional[tuple] = None
+    profile_dir: Optional[str] = None
+
+
+@dataclass
 class FailureConfig:
     """Reference: air/config.py FailureConfig — max_failures<0 = infinite."""
 

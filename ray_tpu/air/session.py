@@ -119,7 +119,9 @@ class _TrainSession:
     def __init__(self, *, world_rank: int, local_rank: int, world_size: int,
                  node_rank: int, trial_name: str = "",
                  checkpoint: Optional[Checkpoint] = None,
-                 dataset_shard: Any = None):
+                 dataset_shard: Any = None,
+                 profile_steps: Optional[tuple] = None,
+                 profile_dir: Optional[str] = None):
         self.world_rank = world_rank
         self.local_rank = local_rank
         self.world_size = world_size
@@ -140,6 +142,74 @@ class _TrainSession:
         self._data_wait_s = 0.0
         self._collective_s = 0.0
         self.last_telemetry: Optional[Dict[str, float]] = None
+        # -- jax.profiler step capture (TrainConfig(profile_steps)) -----
+        self._profile_steps = (tuple(profile_steps)
+                               if profile_steps else None)
+        self._profile_dir = profile_dir
+        self._steps_completed = 0
+        self._profiling = False
+        self._profile_trace_dir: Optional[str] = None
+        self._maybe_profile()  # profile_steps starting at step 1
+
+    def _maybe_profile(self) -> None:
+        """Start/stop a jax.profiler trace at the configured step
+        boundaries (steps are 1-indexed; capture covers [a, b]
+        inclusive). Every failure is swallowed: profiling must never
+        fail a training step."""
+        if self._profile_steps is None:
+            return
+        a, b = self._profile_steps[0], self._profile_steps[-1]
+        next_step = self._steps_completed + 1
+        try:
+            if (not self._profiling and self._profile_trace_dir is None
+                    and a <= next_step <= b):
+                import os
+
+                import jax
+
+                base = self._profile_dir or "/tmp/ray_tpu_profile"
+                trace_dir = os.path.join(
+                    base, self.trial_name or "default",
+                    f"rank{self.world_rank}")
+                os.makedirs(trace_dir, exist_ok=True)
+                jax.profiler.start_trace(trace_dir)
+                self._profiling = True
+                self._profile_trace_dir = trace_dir
+            elif self._profiling and self._steps_completed >= b:
+                import jax
+
+                jax.profiler.stop_trace()
+                self._profiling = False
+                self._publish_profile()
+        except Exception:
+            self._profiling = False
+
+    def _publish_profile(self) -> None:
+        """Advertise the captured trace dir in GCS KV
+        (`train_profile/<trial>/<rank>`) so the dashboard can list it
+        at GET /api/train/profile."""
+        import json
+        import os
+        import socket
+
+        try:
+            from ray_tpu.core.worker import current_runtime
+
+            rt = current_runtime()
+            a, b = self._profile_steps[0], self._profile_steps[-1]
+            rt.kv_put(
+                f"train_profile/{self.trial_name or 'default'}/"
+                f"{self.world_rank}",
+                json.dumps({
+                    "trial": self.trial_name or "default",
+                    "rank": self.world_rank,
+                    "trace_dir": self._profile_trace_dir,
+                    "steps": [a, b],
+                    "hostname": socket.gethostname(),
+                    "pid": os.getpid(),
+                }).encode())
+        except Exception:
+            pass  # publication is best-effort; the trace dir survives
 
     def _close_step(self) -> Dict[str, float]:
         step_wall = max(0.0, time.perf_counter() - self._step_t0)
@@ -162,6 +232,8 @@ class _TrainSession:
             pass  # telemetry must never fail a training step
         self._data_wait_s = 0.0
         self._collective_s = 0.0
+        self._steps_completed += 1
+        self._maybe_profile()
         return telemetry
 
     def report(self, metrics: Dict[str, Any],

@@ -683,19 +683,34 @@ class ClusterRuntime:
     def _start_metrics_push(self) -> None:
         """Flush this process's app metrics (`ray_tpu.util.metrics`) to
         the node's raylet on the configured interval (reference: the
-        worker->metrics-agent export path)."""
+        worker->metrics-agent export path). With the round-17 pipeline
+        on, the same push carries the process's delta-encoded
+        time-series batch; the raylet folds every process's batch into
+        ONE payload on its next GCS heartbeat."""
+        from ray_tpu.core import metrics_ts
         from ray_tpu.core.config import ray_config
         from ray_tpu.util.metrics import start_metrics_push
 
         wid = (self.worker_id.hex() if self.worker_id is not None
                else f"driver-{os.getpid()}")
+        pipeline = metrics_ts.enabled and ray_config().metrics_pipeline
+        if pipeline:
+            metrics_ts.recorder().configure(ray_config().metrics_ts_ring)
 
         def push(snapshot):
+            ts_batch = None
+            if pipeline:
+                metrics_ts.capture(snapshot)
+                ts_batch = metrics_ts.pending() or None
             # Outer timeout bounds the push thread even when shutdown
             # halts the event loop mid-call (no future to resolve).
             self._loop.run(self._raylet.call(
                 "report_metrics", worker_id=wid, snapshot=snapshot,
-                timeout=5.0), timeout=10.0)
+                ts_batch=ts_batch, timeout=5.0), timeout=10.0)
+            if ts_batch:
+                # Clear-on-ack: a raylet hiccup leaves the batch queued
+                # (bounded ring) for the next interval's retry.
+                metrics_ts.ack(len(ts_batch))
 
         start_metrics_push(
             push, ray_config().metrics_report_interval_ms / 1000.0)

@@ -332,6 +332,37 @@ _D("stall_threshold_ms", 100.0,
    "+ lag measurement written as a JSON report under the session log "
    "dir, surfaced at GET /api/stalls.")
 
+# -- metrics pipeline (round 17 observability) ---------------------------
+_D("metrics_pipeline", True,
+   "Pushed cluster metrics pipeline (core/metrics_ts.py): every process "
+   "delta-encodes its metrics-registry snapshots into a bounded ring and "
+   "ships them to its raylet with the existing report_metrics push; the "
+   "raylet folds all worker batches plus its own runtime gauges into ONE "
+   "coalesced payload piggybacked on the existing GCS heartbeat — fleet "
+   "cost O(nodes), not O(processes). Zero-cost-off like the flight "
+   "recorder: disabling restores the bespoke per-raylet poll path.")
+_D("metrics_ts_ring", 128,
+   "Per-process pending-batch ring capacity (unacked capture intervals "
+   "retained across raylet hiccups before the oldest are dropped).")
+_D("metrics_retention_points", 512,
+   "GCS retention ring: data points kept per series (at the default "
+   "2 s capture interval this is ~17 min of history per series).")
+_D("metrics_max_series", 2000,
+   "GCS series-cardinality cap; pushes for new series past the cap are "
+   "counted as dropped instead of registered (label explosions degrade "
+   "to a visible counter, not unbounded memory).")
+_D("metrics_poll_fallback", False,
+   "Use the legacy per-raylet get_metrics poll path for dashboard "
+   "/metrics and autoscaler gauge reads instead of the GCS fold. "
+   "Kept for one release as an escape hatch; delete with it.")
+_D("slo_eval_period_ms", 1000,
+   "GCS SLO burn-rate evaluation period (multi-window state machine "
+   "over the retention store; rides the health-check loop).")
+_D("timeline_max_events", 20000,
+   "Bounded-payload cap for GET /api/timeline: at most this many trace "
+   "events (most recent kept) are shipped per response; metadata "
+   "events are exempt. Override per-request with max_events=.")
+
 # -- tensor plane --------------------------------------------------------
 _D("tpu_slice_gang_scheduling", True,
    "Treat a TPU slice as an atomic gang for placement-group scheduling.")

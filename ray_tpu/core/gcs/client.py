@@ -211,13 +211,18 @@ class GcsClient:
 
     async def heartbeat(self, node_id: str,
                         resources_available: Dict[str, float],
-                        load: Optional[dict] = None) -> bool:
+                        load: Optional[dict] = None,
+                        metrics: Optional[List[dict]] = None) -> bool:
         """False = the GCS does not recognize this node (it restarted or
-        declared the node dead): the caller must re-register."""
+        declared the node dead): the caller must re-register.
+
+        `metrics` is the node's coalesced metrics-pipeline batch (round
+        17): piggybacking it here keeps the fleet at one push RPC per
+        node per interval."""
         return await self.rpc.call(
             "heartbeat", node_id=node_id,
             resources_available=resources_available, load=load,
-            timeout=5.0)
+            metrics=metrics, timeout=5.0)
 
     async def get_nodes(self) -> List[Dict[str, Any]]:
         return await self.rpc.call("get_nodes")
@@ -318,6 +323,38 @@ class GcsClient:
 
     async def list_placement_groups(self) -> List[Dict[str, Any]]:
         return await self.rpc.call("list_placement_groups")
+
+    # -- metrics pipeline + SLOs (round 17) -----------------------------
+    async def query_metrics(self, series: str, window_s: float = 60.0,
+                            agg: str = "raw",
+                            labels: Optional[Dict[str, str]] = None,
+                            group_by: Optional[List[str]] = None
+                            ) -> Dict[str, Any]:
+        return await self.rpc.call(
+            "query_metrics", series=series, window_s=window_s, agg=agg,
+            labels=labels, group_by=group_by, timeout=10.0)
+
+    async def latest_metrics(self) -> List[Dict[str, Any]]:
+        return await self.rpc.call("latest_metrics", timeout=10.0)
+
+    async def metrics_stats(self) -> Dict[str, Any]:
+        return await self.rpc.call("metrics_stats", timeout=5.0)
+
+    async def register_slo(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        return await self.rpc.call("register_slo", spec=spec, timeout=10.0)
+
+    async def remove_slo(self, name: str) -> bool:
+        return await self.rpc.call("remove_slo", name=name, timeout=10.0)
+
+    async def get_slo(self) -> List[Dict[str, Any]]:
+        return await self.rpc.call("get_slo", timeout=10.0)
+
+    async def dump_flight_record(self, window_s: Optional[float] = None,
+                                 include_events: bool = True
+                                 ) -> Dict[str, Any]:
+        return await self.rpc.call(
+            "dump_flight_record", window_s=window_s,
+            include_events=include_events, timeout=10.0)
 
     # -- misc -----------------------------------------------------------
     async def ping(self) -> str:

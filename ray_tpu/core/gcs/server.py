@@ -76,6 +76,36 @@ class GcsServer:
         # fault-injected dispatch; production dials RpcClients.
         self.raylet_client_factory = None
         self._raylet_clients: Dict[str, Any] = {}
+        # -- metrics pipeline (round 17) ----------------------------------
+        # metric_series is the PERSISTED half (series metadata: identity,
+        # type, labels, help, boundaries — rides the WAL like any table);
+        # the retention rings live only in the store: after a kill -9 the
+        # recovered metadata makes re-pushed series land on their old
+        # identity instead of registering duplicates, while point history
+        # restarts empty.
+        from ray_tpu.core.gcs.metrics_store import MetricsStore, SloTracker
+
+        self.metric_series: Dict[str, Dict[str, Any]] = {}
+        cfg = ray_config()
+        self.metrics = MetricsStore(
+            max_series=cfg.metrics_max_series,
+            points=cfg.metrics_retention_points,
+            on_register=self._on_series_register)
+        self.slo = SloTracker(on_transition=self._on_slo_transition)
+        self._slo_last_eval = 0.0
+
+    def _on_series_register(self, key: str, meta: Dict[str, Any]) -> None:
+        self.metric_series[key] = meta
+        self.mark_dirty("metric_series", key)  # 1 Hz debounced flush
+
+    def _on_slo_transition(self, name: str, old: str, new: str,
+                           burn: float) -> None:
+        from ray_tpu.core import flight
+
+        logger.warning("SLO %s: %s -> %s (burn %.2fx)", name, old, new, burn)
+        if flight.enabled:
+            flight.instant("slo", "slo.burn",
+                           arg=f"{name}:{old}->{new}:burn={burn:.2f}")
 
     @property
     def address(self) -> str:
@@ -88,6 +118,11 @@ class GcsServer:
         simulated raylets against this REAL server through in-process
         loopback dispatch."""
         self._load_storage()
+        # Re-pushed series after a restart must reuse their WAL-recovered
+        # identity (no duplicate registration): seed the store with the
+        # persisted metadata before the first heartbeat can arrive.
+        self.metrics.adopt_metadata(self.metric_series)
+        self._recover_slos()
         # Cluster identity: ephemeral ports get reused across test
         # clusters on one box, and a reconnecting client could silently
         # adopt a FOREIGN cluster that happens to listen on its cached
@@ -135,7 +170,7 @@ class GcsServer:
     # reconciles the live view and clears the flag — no re-register RPC
     # needed, no herd.
     _PERSISTED_TABLES = ("nodes", "actors", "named_actors", "jobs",
-                         "placement_groups", "kv")
+                         "placement_groups", "kv", "metric_series")
 
     def mark_dirty(self, table: Optional[str] = None,
                    *keys: str) -> None:
@@ -458,6 +493,17 @@ class GcsServer:
             # Re-kick stuck reschedules + the mid-pass-race safety net
             # (one shared scan; see _rescan_reschedules).
             await self._rescan_reschedules()
+            # SLO burn-rate evaluation rides this loop rather than its
+            # own task: the simcluster kill -9 cancels a known task set,
+            # and one more periodic scan does not deserve one more task.
+            if self.slo.slos and (
+                    now - self._slo_last_eval
+                    >= cfg.slo_eval_period_ms / 1000.0):
+                self._slo_last_eval = now
+                try:
+                    self.slo.evaluate(self.metrics, now=now)
+                except Exception:
+                    logger.warning("SLO evaluation failed", exc_info=True)
 
     async def _mark_node_dead(self, node_id: str) -> None:
         info = self.nodes.get(node_id)
@@ -681,7 +727,9 @@ class GcsServer:
 
     async def handle_heartbeat(self, conn: ServerConnection, *, node_id: str,
                                resources_available: Dict[str, float],
-                               load: Optional[Dict[str, Any]] = None) -> bool:
+                               load: Optional[Dict[str, Any]] = None,
+                               metrics: Optional[List[Dict[str, Any]]] = None,
+                               ) -> bool:
         info = self.nodes.get(node_id)
         if info is None or not info.get("alive", False):
             # Unknown (registration lost with an unpersisted crash) or
@@ -700,6 +748,15 @@ class GcsServer:
         conn.metadata["node_id"] = node_id
         if load is not None:
             info["load"] = load
+        if metrics:
+            # The node's coalesced metrics push rides the heartbeat — one
+            # RPC per node per interval, whatever the worker count.
+            try:
+                self.metrics.ingest(
+                    metrics, extra_labels={"node_id": node_id[:8]})
+            except Exception:
+                logger.warning("bad metrics batch from %s",
+                               node_id[:8], exc_info=True)
         return True
 
     async def handle_get_nodes(self, conn: ServerConnection,
@@ -938,6 +995,77 @@ class GcsServer:
         return list(self.placement_groups.values())
 
     # ------------------------------------------------------------------
+    # metrics pipeline + SLOs (round 17 observability)
+    # ------------------------------------------------------------------
+    async def handle_query_metrics(
+            self, conn: ServerConnection, *, series: str,
+            window_s: float = 60.0, agg: str = "raw",
+            labels: Optional[Dict[str, str]] = None,
+            group_by: Optional[List[str]] = None) -> Dict[str, Any]:
+        return self.metrics.query(series, window_s=float(window_s),
+                                  agg=agg, labels=labels, group_by=group_by)
+
+    async def handle_latest_metrics(self, conn: ServerConnection
+                                    ) -> List[Dict[str, Any]]:
+        """The latest cluster-wide fold, registry-snapshot shaped (what
+        the dashboard renders as Prometheus text at GET /metrics)."""
+        return self.metrics.latest_fold()
+
+    async def handle_metrics_stats(self, conn: ServerConnection
+                                   ) -> Dict[str, Any]:
+        return self.metrics.stats()
+
+    async def handle_register_slo(self, conn: ServerConnection, *,
+                                  spec: Dict[str, Any]) -> Dict[str, Any]:
+        spec = self.slo.register(dict(spec))
+        # Specs are cheap and declarative — persist them in kv so a
+        # restarted GCS keeps watching the same objectives.
+        import json
+
+        self.kv[f"__slo__/{spec['name']}"] = json.dumps(spec).encode()
+        self.mark_dirty("kv", f"__slo__/{spec['name']}")
+        await self.flush_now()
+        return spec
+
+    async def handle_remove_slo(self, conn: ServerConnection, *,
+                                name: str) -> bool:
+        self.kv.pop(f"__slo__/{name}", None)
+        self.mark_dirty("kv", f"__slo__/{name}")
+        return self.slo.remove(name)
+
+    async def handle_get_slo(self, conn: ServerConnection
+                             ) -> List[Dict[str, Any]]:
+        return self.slo.status(self.metrics)
+
+    def _recover_slos(self) -> None:
+        import json
+
+        for k, v in self.kv.items():
+            if not k.startswith("__slo__/"):
+                continue
+            try:
+                self.slo.register(json.loads(
+                    v.decode() if isinstance(v, bytes) else v))
+            except Exception:
+                logger.warning("unreadable persisted SLO %s", k,
+                               exc_info=True)
+
+    async def handle_dump_flight_record(
+            self, conn: ServerConnection, *,
+            window_s: Optional[float] = None,
+            include_events: bool = True) -> Dict[str, Any]:
+        """The GCS's own flight ring (slo.burn, node.dead, ...), shaped
+        like the raylet's dump handler so the dashboard merge code can
+        treat the GCS as one more source on /api/timeline."""
+        from ray_tpu.core import flight
+
+        if not flight.enabled:
+            return {"node_id": "gcs", "records": []}
+        return {"node_id": "gcs",
+                "records": [flight.dump(window_s=window_s,
+                                        include_events=include_events)]}
+
+    # ------------------------------------------------------------------
     # misc
     # ------------------------------------------------------------------
     async def handle_ping(self, conn: ServerConnection) -> str:
@@ -966,6 +1094,14 @@ def main() -> None:
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
+
+    from ray_tpu.core import flight
+
+    if flight.enabled:
+        # The standalone GCS is a flight source too: slo.burn and
+        # node.dead events merge onto /api/timeline next to the stalls
+        # that caused them (the dashboard scrapes dump_flight_record).
+        flight.set_role("gcs")
 
     async def run():
         server = GcsServer(args.host, args.port,

@@ -500,6 +500,19 @@ class Raylet(NodeLedger):
         self._flight_sources: Dict[str, float] = {}
         self._push_sem: Optional[asyncio.Semaphore] = None
         self._push_waiters = 0
+        # Metrics pipeline (round 17): workers' delta batches queue here
+        # (already worker/role-labeled) until the next heartbeat folds
+        # them — with the raylet's own runtime gauges — into the ONE
+        # coalesced `metrics=` payload piggybacked on that heartbeat.
+        # Bounded like the per-process ring; cleared only on GCS ack.
+        from ray_tpu.core import metrics_ts
+
+        self._metrics_pending: List[Dict[str, Any]] = []
+        self._ts_recorder = metrics_ts.Recorder(
+            capacity=ray_config().metrics_ts_ring)
+        self._last_ts_capture = 0.0
+        self._metrics_pushes = 0       # heartbeats that carried metrics
+        self._metrics_hb_intervals = 0  # heartbeat-loop iterations
 
     @property
     def address(self) -> str:
@@ -595,11 +608,50 @@ class Raylet(NodeLedger):
                 if worker.actor_id and worker.proc.poll() is None:
                     worker.proc.terminate()
 
+    def _fold_metrics_batch(self) -> Optional[list]:
+        """The node's coalesced pipeline payload for this heartbeat:
+        the raylet's own runtime gauges (captured at the report
+        interval, delta-encoded through the same Recorder workers use)
+        plus every queued worker batch. None = nothing to push."""
+        from ray_tpu.core import metrics_ts
+
+        if not (metrics_ts.enabled and ray_config().metrics_pipeline):
+            return None
+        now = time.monotonic()
+        if (now - self._last_ts_capture
+                >= ray_config().metrics_report_interval_ms / 1000.0):
+            self._last_ts_capture = now
+            try:
+                self._ts_recorder.capture(self._runtime_metrics())
+            except Exception:
+                logger.warning("runtime metrics capture failed",
+                               exc_info=True)
+        own = self._ts_recorder.pending()
+        if not own and not self._metrics_pending:
+            return None
+        batch = [{"t": e["t"],
+                  "series": [[it[0], it[1], dict(it[2], role="raylet")]
+                             + list(it[3:]) for it in e["series"]]}
+                 for e in own]
+        batch.extend(self._metrics_pending)
+        # Remember what was shipped so only THAT is acked — workers may
+        # append more while the heartbeat RPC is in flight.
+        self._metrics_sent = (len(own), len(self._metrics_pending))
+        return batch
+
+    def _ack_metrics_batch(self) -> None:
+        n_own, n_workers = getattr(self, "_metrics_sent", (0, 0))
+        self._ts_recorder.ack(n_own)
+        del self._metrics_pending[:n_workers]
+        self._metrics_pushes += 1
+
     async def _heartbeat_loop(self) -> None:
         period = ray_config().raylet_heartbeat_period_ms / 1000.0
         last_view = 0.0
         while True:
             try:
+                metrics_batch = self._fold_metrics_batch()
+                self._metrics_hb_intervals += 1
                 ok = await self._gcs.heartbeat(
                     self.node_id, self.resources_available,
                     load={"pending": len(self._pending),
@@ -607,7 +659,12 @@ class Raylet(NodeLedger):
                           # bin-packing (reference: load metrics'
                           # resource_load_by_shape).
                           "pending_demands": [dict(p.demand) for p in
-                                              self._pending[:100]]})
+                                              self._pending[:100]]},
+                    metrics=metrics_batch)
+                if ok is True and metrics_batch:
+                    # Clear-on-ack: a failed/unrecognized heartbeat
+                    # leaves the batch queued for the next interval.
+                    self._ack_metrics_batch()
                 if ok is False:
                     # GCS restarted (nodes aren't persisted) or declared
                     # us dead: re-register so scheduling resumes (GCS FT
@@ -1187,14 +1244,36 @@ class Raylet(NodeLedger):
     # per-node metrics agent, _private/metrics_agent.py)
     # ------------------------------------------------------------------
     async def handle_report_metrics(self, conn: ServerConnection, *,
-                                    worker_id: str, snapshot: list) -> bool:
-        """A worker/driver process pushes its app-metric snapshot."""
+                                    worker_id: str, snapshot: list,
+                                    ts_batch: Optional[list] = None) -> bool:
+        """A worker/driver process pushes its app-metric snapshot (and,
+        round 17, its delta-encoded time-series batch — queued here
+        until the next GCS heartbeat folds the whole node)."""
         self._worker_metrics[worker_id] = (time.monotonic(), snapshot)
+        if ts_batch:
+            role = ("driver" if worker_id.startswith("driver-")
+                    else "worker")
+            wid8 = worker_id[:8]
+            for entry in ts_batch:
+                self._metrics_pending.append({
+                    "t": entry.get("t"),
+                    "series": [
+                        [it[0], it[1],
+                         dict(it[2], worker_id=wid8, role=role)]
+                        + list(it[3:])
+                        for it in entry.get("series", ())]})
+            # Bounded like every other ring: a GCS outage must not grow
+            # raylet memory without limit. Oldest entries go first.
+            cap = max(1, ray_config().metrics_ts_ring) * 4
+            overflow = len(self._metrics_pending) - cap
+            if overflow > 0:
+                del self._metrics_pending[:overflow]
         return True
 
-    async def handle_get_metrics(self, conn: ServerConnection) -> list:
-        """Node-wide snapshot: raylet runtime gauges + every live
-        process's pushed app metrics (dashboard /metrics scrapes this)."""
+    def _runtime_metrics(self) -> list:
+        """The raylet's own runtime gauges, registry-snapshot shaped
+        (shared by the legacy get_metrics scrape and the pushed
+        pipeline's per-interval capture)."""
         stats = self.store.stats()
         runtime = [{
             "name": f"ray_tpu_{key}", "type": "gauge", "help": help_,
@@ -1220,6 +1299,14 @@ class Raylet(NodeLedger):
                 "help": "Schedulable resource availability",
                 "samples": [{"tags": {"resource": res},
                              "value": float(avail)}]})
+        return runtime
+
+    async def handle_get_metrics(self, conn: ServerConnection) -> list:
+        """Node-wide snapshot: raylet runtime gauges + every live
+        process's pushed app metrics. The legacy poll path — the
+        dashboard and autoscaler now read the GCS fold instead (round
+        17); kept behind `metrics_poll_fallback` for one release."""
+        runtime = self._runtime_metrics()
         from ray_tpu.util.metrics import merge_snapshots
 
         # Stale = missed ~3 push intervals (dead worker); prune, don't
@@ -1233,6 +1320,17 @@ class Raylet(NodeLedger):
             ({"node_id": self.node_id[:8], "worker_id": wid[:8]}, snap)
             for wid, (ts, snap) in self._worker_metrics.items()]
         return merge_snapshots(per_source)
+
+    async def handle_metrics_push_stats(self, conn: ServerConnection
+                                        ) -> Dict[str, Any]:
+        """Structural accounting for the perf guard: pushes (heartbeats
+        that carried a metrics payload) must never exceed heartbeat
+        intervals — i.e. one coalesced push RPC per node per interval."""
+        return {"node_id": self.node_id,
+                "pushes": self._metrics_pushes,
+                "intervals": self._metrics_hb_intervals,
+                "pending": len(self._metrics_pending),
+                "recorder_dropped": self._ts_recorder.dropped}
 
     async def handle_object_store_stats(self, conn: ServerConnection
                                         ) -> Dict[str, Any]:

@@ -17,14 +17,26 @@ Endpoints:
   GET /api/tasks?job_id=...    task events
   GET /api/serve               per-deployment QPS/latency/queue state
   GET /api/train               per-trial step-time telemetry
+  GET /api/train/profile       published jax.profiler trace dirs per
+                               trial/rank (TrainConfig(profile_steps=…))
   GET /api/logs?node=&worker=  per-worker log tails (id-prefix filters)
   GET /api/timeline?window_s=  merged Chrome-trace JSON: every process's
-                               flight-recorder ring (task/lease/ring/gc/
-                               loop/engine events), clock-skew aligned —
+          &category=&pid=      flight-recorder ring (task/lease/ring/gc/
+          &max_events=         loop/engine/slo events), clock-skew
+                               aligned, filterable and payload-capped —
                                open in Perfetto / chrome://tracing
   GET /api/stalls              stall episodes the loop-lag watchdogs
                                captured (lag, report path, per-process)
-  GET /metrics                 Prometheus text: all nodes + app metrics
+  GET /api/metrics/query       windowed time-series reads from the GCS
+          ?series=&window_s=   retention store: agg = raw | rate | sum |
+          &agg=&group_by=      avg | max | min | pNN (quantile-over-time
+                               on pushed histogram buckets)
+  GET /api/slo                 declared objectives + multi-window
+                               burn-rate state (ok/warning/page)
+  GET /metrics                 Prometheus text: the GCS's latest
+                               cluster-wide fold of the pushed pipeline
+                               (legacy per-raylet poll behind
+                               `metrics_poll_fallback`)
   GET /                        tiny HTML index
 
 Started by `Node.start_head` (flag `dashboard=True`) as
@@ -53,9 +65,11 @@ _INDEX_HTML = """<!doctype html>
 <li><a href=/api/cluster_status>cluster status</a>
 <li><a href=/api/serve>serve deployments</a>
 <li><a href=/api/train>train telemetry</a>
+<li><a href=/api/train/profile>train profiler traces</a>
 <li><a href=/api/logs>worker logs</a>
 <li><a href=/api/timeline>flight-recorder timeline (chrome trace)</a>
 <li><a href=/api/stalls>stall episodes</a>
+<li><a href=/api/slo>SLO burn-rate state</a>
 <li><a href=/metrics>metrics (prometheus)</a>
 </ul>
 """
@@ -171,11 +185,35 @@ class DashboardHead:
             return await self._serve_state()
         if endpoint == "train":
             return await self._train_state()
+        if endpoint == "train/profile":
+            return await self._train_profiles()
         if endpoint == "timeline":
+            raw_max = query.get("max_events", [None])[0]
             return await self._timeline(
-                window_s=float(query.get("window_s", ["60"])[0]))
+                window_s=float(query.get("window_s", ["60"])[0]),
+                category=query.get("category", [None])[0],
+                pid=query.get("pid", [None])[0],
+                max_events=int(raw_max) if raw_max else None)
         if endpoint == "stalls":
             return await self._stalls()
+        if endpoint == "metrics/query":
+            series = query.get("series", [None])[0]
+            if not series:
+                return {"error": "series= is required"}
+            labels = None
+            raw_labels = query.get("labels", [None])[0]
+            if raw_labels:  # "k1=v1,k2=v2"
+                labels = dict(p.split("=", 1)
+                              for p in raw_labels.split(",") if "=" in p)
+            group_by = query.get("group_by", [None])[0]
+            return await self._gcs.query_metrics(
+                series,
+                window_s=float(query.get("window_s", ["60"])[0]),
+                agg=query.get("agg", ["raw"])[0],
+                labels=labels,
+                group_by=group_by.split(",") if group_by else None)
+        if endpoint == "slo":
+            return await self._gcs.get_slo()
         if endpoint == "logs":
             return await self._logs(
                 node=query.get("node", [None])[0],
@@ -246,15 +284,39 @@ class DashboardHead:
     # series every node pushes into per-deployment / per-trial JSON the
     # frontend-to-be would chart; reference: Serve's and Train's
     # dashboard panes over the same Prometheus series) -----------------
+    async def _fold_snapshots(self) -> list:
+        """The cluster's merged metrics, registry-snapshot shaped.
+
+        Primary source (round 17): the GCS's latest fold of the pushed
+        pipeline — one RPC, no per-node fan-out. The legacy per-raylet
+        `get_metrics` poll survives behind `metrics_poll_fallback` (one
+        release) and as the empty-fold fallback so a cluster whose
+        first push has not landed yet still reports."""
+        from ray_tpu.core import metrics_ts
+        from ray_tpu.core.config import ray_config
+
+        cfg = ray_config()
+        if (metrics_ts.enabled and cfg.metrics_pipeline
+                and not cfg.metrics_poll_fallback):
+            try:
+                fold = await self._gcs.latest_metrics()
+            except Exception:
+                fold = None
+            if fold:
+                return fold
+        from ray_tpu.util.metrics import merge_snapshots
+
+        results = await self._per_node("get_metrics")
+        per_node = [({}, snaps) for snaps in results
+                    if isinstance(snaps, list)]  # dicts = scrape errors
+        return merge_snapshots(per_node) if per_node else []
+
     async def _workload_snapshot(self, prefix: str):
         merged: Dict[str, Any] = {}
-        for snaps in await self._per_node("get_metrics"):
-            if not isinstance(snaps, list):
-                continue  # dict = scrape error
-            for m in snaps:
-                if m["name"].startswith(prefix):
-                    merged.setdefault(m["name"], []).extend(
-                        m.get("samples", []))
+        for m in await self._fold_snapshots():
+            if m["name"].startswith(prefix):
+                merged.setdefault(m["name"], []).extend(
+                    m.get("samples", []))
         return merged
 
     @staticmethod
@@ -349,6 +411,18 @@ class DashboardHead:
                     kind, 0.0) + float(s.get("sum", 0.0))
         for s in m.get("train_gang_workers", []):
             slot(s["tags"].get("trial", "?"))["workers"] = s["value"]
+        # Fold in published jax.profiler traces (satellite: the train
+        # pane links straight to each trial's capture).
+        try:
+            for row in await self._train_profiles():
+                trial = row.get("trial")
+                if trial in trials:
+                    trials[trial].setdefault("profiles", []).append({
+                        "rank": row.get("rank"),
+                        "trace_dir": row.get("trace_dir"),
+                        "url": "/api/train/profile"})
+        except Exception:
+            pass  # profile listing must never break the train pane
         return {"trials": trials}
 
     async def _logs(self, node: Optional[str] = None,
@@ -370,26 +444,61 @@ class DashboardHead:
                 merged.append(r)
         return merged
 
-    async def _timeline(self, window_s: float = 60.0) -> Dict[str, Any]:
+    async def _flight_sources(self, **kwargs) -> list:
+        """Every flight dump source: per-node fan-out (each raylet
+        returns its own ring + every live worker's) PLUS the GCS — its
+        ring carries slo.burn and node.dead events (round 17)."""
+        results = await self._per_node("dump_flight_record", **kwargs)
+        try:
+            gcs_dump = await self._gcs.dump_flight_record(**kwargs)
+            if isinstance(gcs_dump, dict):
+                results.append(gcs_dump)
+        except Exception:
+            pass  # pre-round-17 GCS: no handler
+        return results
+
+    async def _timeline(self, window_s: float = 60.0,
+                        category: Optional[str] = None,
+                        pid: Optional[str] = None,
+                        max_events: Optional[int] = None) -> Dict[str, Any]:
         """Cluster flight-recorder timeline: fan out
         `dump_flight_record` (each raylet returns its own ring + every
-        live worker's), then merge into ONE Chrome-trace JSON — clock
-        skew aligned through each process's wall<->monotonic anchor.
-        Save the response to a file and open it in Perfetto."""
-        from ray_tpu.core import flight
+        live worker's, plus the GCS's), then merge into ONE Chrome-trace
+        JSON — clock skew aligned through each process's wall<->monotonic
+        anchor. Save the response to a file and open it in Perfetto.
 
-        results = await self._per_node("dump_flight_record",
-                                       window_s=window_s)
+        `category=`/`pid=` filter events server-side; the non-metadata
+        event count is capped (`timeline_max_events`, most recent kept)
+        so full rings from many workers can't blow up the JSON path."""
+        from ray_tpu.core import flight
+        from ray_tpu.core.config import ray_config
+
+        results = await self._flight_sources(window_s=window_s)
         records = [rec for res in results if isinstance(res, dict)
                    for rec in res.get("records", [])]
-        return flight.to_chrome_trace(records)
+        trace = flight.to_chrome_trace(records)
+        events = trace.get("traceEvents", [])
+        meta = [e for e in events if e.get("ph") == "M"]
+        body = [e for e in events if e.get("ph") != "M"]
+        if category is not None:
+            body = [e for e in body if e.get("cat") == category]
+        if pid is not None:
+            want = int(pid)
+            body = [e for e in body if e.get("pid") == want]
+        cap = (max_events if max_events is not None
+               else ray_config().timeline_max_events)
+        if cap and len(body) > cap:
+            body.sort(key=lambda e: e.get("ts", 0))
+            trace["truncated_events"] = len(body) - cap
+            body = body[-cap:]
+        trace["traceEvents"] = meta + body
+        return trace
 
     async def _stalls(self) -> list:
         """Stall episodes captured by every process's loop-lag
         watchdog, newest first (full forensics — ring snapshot + stack
         dump — live in each episode's report_path file on its node)."""
-        results = await self._per_node("dump_flight_record",
-                                       include_events=False)
+        results = await self._flight_sources(include_events=False)
         episodes = []
         for res in results:
             if not isinstance(res, dict):
@@ -407,17 +516,35 @@ class DashboardHead:
         episodes.sort(key=lambda e: e.get("ts_wall", 0), reverse=True)
         return episodes
 
-    async def _metrics(self) -> str:
-        from ray_tpu.util.metrics import merge_snapshots, render_prometheus
+    async def _train_profiles(self) -> list:
+        """jax.profiler trace dirs published by train workers
+        (TrainConfig(profile_steps=(a, b))): one row per trial/rank,
+        pointing at the trace directory on that worker's node — open
+        with TensorBoard's profile plugin or xprof."""
+        rows = []
+        for key in await self._gcs.kv_keys("train_profile/"):
+            raw = await self._gcs.kv_get(key)
+            if raw is None:
+                continue
+            try:
+                row = json.loads(raw.decode()
+                                 if isinstance(raw, bytes) else raw)
+            except Exception:
+                row = {"error": "unreadable profile record"}
+            row["key"] = key
+            rows.append(row)
+        rows.sort(key=lambda r: (r.get("trial", ""), r.get("rank", 0)))
+        return rows
 
-        results = await self._per_node("get_metrics")
-        per_node = [({}, snaps) for snaps in results
-                    if isinstance(snaps, list)]  # dicts = scrape errors
-        if not per_node:
+    async def _metrics(self) -> str:
+        from ray_tpu.util.metrics import render_prometheus
+
+        snaps = await self._fold_snapshots()
+        if not snaps:
             return "# no nodes reporting\n"
         # Single render over the merged snapshots: one HELP/TYPE header
         # per metric name (duplicate headers break Prometheus parsers).
-        return render_prometheus(merge_snapshots(per_node))
+        return render_prometheus(snaps)
 
 
 async def _amain(gcs: str, host: str, port: int) -> None:

@@ -18,6 +18,10 @@ reference budgets at 50-300 µs/task (SURVEY §3.2). Run directly:
                                        # Chrome trace (round 12)
     python -m ray_tpu.perf --flight-overhead
                                        # recorder-on vs off tasks/s
+    python -m ray_tpu.perf --metrics-overhead
+                                       # metrics pipeline on vs off
+                                       # tasks/s + push/interval counts
+                                       # (round 17)
 
 `--attribute` turns on the per-call attribution profiler
 (core/attribution.py) for the driver AND every worker it spawns, then
@@ -518,6 +522,87 @@ def run_flight_overhead_bench(scale: float = 1.0,
     return out
 
 
+def run_metrics_overhead_bench(scale: float = 1.0,
+                               bursts: int = 4) -> Dict[str, Any]:
+    """Metrics-pipeline-on vs -off remote tasks/s — the "cheap when on"
+    pin for the round-17 pushed time-series pipeline (guarded at <=10%
+    delta in `tests/test_perf_guards.py::test_metrics_pipeline_overhead`).
+
+    Same discipline as the flight-overhead bench: two sequential
+    clusters (workers inherit the env flag at spawn), fold-best of
+    `bursts` bursts per side. Before tearing down the ON cluster we
+    scrape every raylet's `metrics_push_stats` so the guard can also
+    assert the structural invariant: one heartbeat interval produces at
+    most one metrics push RPC per node (pushes <= intervals).
+    """
+    import os
+
+    import ray_tpu
+    from ray_tpu.core import metrics_ts
+
+    out: Dict[str, Any] = {}
+    prev_env = os.environ.get(metrics_ts.ENV_FLAG)
+    prev_enabled = metrics_ts.enabled
+    ncpu = min(4, max(2, os.cpu_count() or 1))
+    n = max(1, int(800 * scale))
+
+    def measure() -> float:
+        noop = ray_tpu.remote(_metadata={"inline": False})(_noop)
+        ray_tpu.get([noop.remote() for _ in range(10)], timeout=120)
+        best = 0.0
+        for _ in range(max(1, bursts)):
+            t0 = time.perf_counter()
+            ray_tpu.get([noop.remote() for _ in range(n)], timeout=300)
+            best = max(best, n / (time.perf_counter() - t0))
+        return round(best, 1)
+
+    def scrape_push_stats() -> List[Dict[str, Any]]:
+        rt = ray_tpu.core.worker.current_runtime()
+
+        async def _collect():
+            stats = []
+            for node in await rt._gcs.get_nodes():
+                if not node.get("alive", True):
+                    continue
+                try:
+                    client = await rt._raylet_client(node["address"])
+                    stats.append(await client.call(
+                        "metrics_push_stats", timeout=10.0))
+                except Exception:  # noqa: BLE001 — skip a dead node
+                    pass
+            return stats
+
+        return [s for s in rt._loop.run(_collect(), timeout=30)
+                if isinstance(s, dict)]
+
+    try:
+        ray_tpu.shutdown()
+        metrics_ts.enable()
+        ray_tpu.init(num_cpus=ncpu, ignore_reinit_error=True)
+        out["tasks_per_s_metrics_on"] = measure()
+        stats = scrape_push_stats()
+        out["push_pushes"] = sum(s.get("pushes", 0) for s in stats)
+        out["push_intervals"] = sum(s.get("intervals", 0) for s in stats)
+        out["push_nodes"] = len(stats)
+        out["push_recorder_dropped"] = sum(
+            s.get("recorder_dropped", 0) for s in stats)
+        ray_tpu.shutdown()
+        metrics_ts.disable()
+        ray_tpu.init(num_cpus=ncpu, ignore_reinit_error=True)
+        out["tasks_per_s_metrics_off"] = measure()
+    finally:
+        ray_tpu.shutdown()
+        if prev_env is None:
+            os.environ.pop(metrics_ts.ENV_FLAG, None)
+        else:
+            os.environ[metrics_ts.ENV_FLAG] = prev_env
+        metrics_ts.enabled = prev_enabled
+    out["metrics_ratio"] = round(
+        out["tasks_per_s_metrics_on"]
+        / max(out["tasks_per_s_metrics_off"], 1e-9), 3)
+    return out
+
+
 def run_simcluster_bench(n_nodes: int = 100,
                          scale: float = 1.0) -> Dict[str, Any]:
     """Control-plane throughput at N simulated nodes (ISSUE 14): lease
@@ -850,6 +935,11 @@ def main() -> None:
     p.add_argument("--flight-overhead", action="store_true",
                    help="measure recorder-on vs recorder-off tasks/s "
                         "(the <=10%% 'cheap when on' pin)")
+    p.add_argument("--metrics-overhead", action="store_true",
+                   help="measure metrics-pipeline-on vs -off tasks/s "
+                        "plus per-node push/interval counters (the "
+                        "round-17 <=10%% pin + the one-push-per-"
+                        "heartbeat structural invariant)")
     p.add_argument("--simcluster", action="store_true",
                    help="run ONLY the simulated-raylet control-plane "
                         "bench: lease grants/s and placement-group "
@@ -876,6 +966,9 @@ def main() -> None:
         return
     if args.flight_overhead:
         print(json.dumps(run_flight_overhead_bench(scale=args.scale)))
+        return
+    if args.metrics_overhead:
+        print(json.dumps(run_metrics_overhead_bench(scale=args.scale)))
         return
 
     result = run_microbench(local_mode=args.local, scale=args.scale,

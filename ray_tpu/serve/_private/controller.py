@@ -456,27 +456,45 @@ class ServeController:
     async def _collect_metric_snapshots(self) -> list:
         """Every process's pushed app-metric snapshot: the local registry
         (covers local mode, where proxies/routers/replicas share this
-        process) plus each alive raylet's merged worker snapshots
-        (cluster mode — the same feed the dashboard /metrics uses)."""
+        process) plus the cluster-wide view.
+
+        Round 17: the cluster half reads the GCS's latest pipeline fold
+        — ONE RPC instead of a get_metrics poll per raylet per
+        autoscale tick (the bespoke poll path this satellite deletes).
+        `metrics_poll_fallback` restores the old fan-out for one
+        release; an empty fold (pipeline warming up) also falls back."""
         from ray_tpu.util.metrics import default_registry
 
         snaps = list(default_registry().snapshot())
+        from ray_tpu.core import metrics_ts
+        from ray_tpu.core.config import ray_config
         from ray_tpu.core.worker import current_runtime
 
         rt = current_runtime()
-        if not getattr(rt, "is_local_mode", False):
+        if getattr(rt, "is_local_mode", False):
+            return snaps
+        cfg = ray_config()
+        if (metrics_ts.enabled and cfg.metrics_pipeline
+                and not cfg.metrics_poll_fallback):
             try:
-                for n in await rt._gcs.get_nodes():
-                    if not n.get("alive"):
-                        continue
-                    try:
-                        client = await rt._raylet_client(n["address"])
-                        snaps.extend(await client.call("get_metrics",
-                                                       timeout=5.0))
-                    except Exception:
-                        continue
+                fold = await rt._gcs.latest_metrics()
+                if fold:
+                    snaps.extend(fold)
+                    return snaps
             except Exception:
-                pass
+                pass  # fold unavailable — fall through to the poll
+        try:
+            for n in await rt._gcs.get_nodes():
+                if not n.get("alive"):
+                    continue
+                try:
+                    client = await rt._raylet_client(n["address"])
+                    snaps.extend(await client.call("get_metrics",
+                                                   timeout=5.0))
+                except Exception:
+                    continue
+        except Exception:
+            pass
         return snaps
 
     async def _autoscale(self, state: _DeploymentState) -> None:

@@ -8,7 +8,7 @@ TPU-native: the process-group seam is `jax.distributed` + XLA collectives
 
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
-                                ScalingConfig)
+                                ScalingConfig, TrainConfig)
 from ray_tpu.air.result import Result
 from ray_tpu.air import session
 from ray_tpu.air.session import (get_checkpoint, get_dataset_shard,
@@ -40,7 +40,8 @@ def get_mesh(shape=None, *, dp_across_slices: bool = True, devices=None):
 __all__ = [
     "Backend", "BackendConfig", "Checkpoint", "CheckpointConfig",
     "FailureConfig", "JaxConfig", "JaxTrainer", "Result", "RunConfig",
-    "ScalingConfig", "TrainingFailedError", "get_mesh", "session",
+    "ScalingConfig", "TrainConfig", "TrainingFailedError", "get_mesh",
+    "session",
     "report", "get_checkpoint", "get_dataset_shard", "get_local_rank",
     "get_node_rank", "get_world_rank", "get_world_size",
 ]

@@ -103,7 +103,9 @@ class BackendExecutor:
 
     def start_training(self, train_fn: Callable, config: Optional[dict],
                        *, trial_name: str = "", checkpoint=None,
-                       dataset_shards: Optional[List[Any]] = None) -> None:
+                       dataset_shards: Optional[List[Any]] = None,
+                       profile_steps: Optional[tuple] = None,
+                       profile_dir: Optional[str] = None) -> None:
         wg = self.worker_group
         assert wg is not None, "call start() first"
         self._trial_name = trial_name or "default"
@@ -129,7 +131,8 @@ class BackendExecutor:
                 train_fn, config, world_rank=i, local_rank=local_rank,
                 world_size=len(wg), node_rank=node_order.index(node),
                 trial_name=trial_name, checkpoint=checkpoint,
-                dataset_shard=shard))
+                dataset_shard=shard, profile_steps=profile_steps,
+                profile_dir=profile_dir))
         ray_tpu.get(refs, timeout=300)
 
     def get_next_results(

@@ -52,7 +52,8 @@ class TrainWorker:
     def start_training(self, train_fn, config: Optional[dict],
                        *, world_rank: int, local_rank: int, world_size: int,
                        node_rank: int, trial_name: str = "",
-                       checkpoint=None, dataset_shard=None) -> bool:
+                       checkpoint=None, dataset_shard=None,
+                       profile_steps=None, profile_dir=None) -> bool:
         import threading
 
         from ray_tpu.air.session import (_StopTraining, _TrainSession,
@@ -67,7 +68,8 @@ class TrainWorker:
             world_rank=world_rank, local_rank=local_rank,
             world_size=world_size, node_rank=node_rank,
             trial_name=trial_name, checkpoint=checkpoint,
-            dataset_shard=dataset_shard)
+            dataset_shard=dataset_shard, profile_steps=profile_steps,
+            profile_dir=profile_dir)
         self._session = session
         _set_session(session)
 

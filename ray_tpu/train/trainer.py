@@ -17,7 +17,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ray_tpu.air.checkpoint import Checkpoint
-from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.air.config import RunConfig, ScalingConfig, TrainConfig
 from ray_tpu.air.result import Result
 from ray_tpu.train._internal.backend_executor import (BackendExecutor,
                                                       TrainingWorkerError)
@@ -37,7 +37,8 @@ class JaxTrainer:
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
                  datasets: Optional[Dict[str, Any]] = None,
-                 resume_from_checkpoint: Optional[Checkpoint] = None):
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 train_config: Optional["TrainConfig"] = None):
         import cloudpickle
 
         # Pre-pickled on the driver; workers resolve driver-local modules
@@ -49,6 +50,7 @@ class JaxTrainer:
         self._run_config = run_config or RunConfig()
         self._datasets = datasets or {}
         self._resume_checkpoint = resume_from_checkpoint
+        self._instrumentation = train_config
 
     # ------------------------------------------------------------------
     def fit(self) -> Result:
@@ -113,7 +115,11 @@ class JaxTrainer:
                 executor.start_training(
                     self._train_fn, self._train_config,
                     trial_name=run_name, checkpoint=latest_ckpt,
-                    dataset_shards=self._dataset_shards())
+                    dataset_shards=self._dataset_shards(),
+                    profile_steps=(self._instrumentation.profile_steps
+                                   if self._instrumentation else None),
+                    profile_dir=(self._instrumentation.profile_dir
+                                 if self._instrumentation else None))
                 while True:
                     results = executor.get_next_results()
                     if results is None:

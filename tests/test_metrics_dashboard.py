@@ -487,3 +487,268 @@ def test_per_task_cprofile_optin(ray_cluster):
     assert dumps, f"no profile dump in {node.log_dir}"
     text = open(dumps[0]).read()
     assert "cumulative" in text and "crunch" in text
+
+
+# ---------------------------------------------------------------------------
+# Round 17: pushed metrics pipeline endpoints (query, SLO, timeline
+# filters, train profiles)
+# ---------------------------------------------------------------------------
+
+def test_metrics_query_endpoint(ray_cluster):
+    """`/api/metrics/query` serves windowed reads from the GCS
+    retention store: raw points for a pushed runtime gauge, and
+    rate/group_by over a counter a task just bumped."""
+    import time
+
+    import ray_tpu
+
+    base = _dashboard_url(ray_tpu)
+
+    @ray_tpu.remote
+    def bump_query_probe():
+        from ray_tpu.util.metrics import Counter
+
+        c = Counter("query_probe_total", "probe", tag_keys=("kind",))
+        c.inc(30, tags={"kind": "q"})
+        time.sleep(3.0)  # one metrics_report_interval flush
+        return True
+
+    assert ray_tpu.get(bump_query_probe.remote(), timeout=120)
+
+    deadline = time.time() + 40
+    data = {}
+    while time.time() < deadline:
+        status, body = _get(base + "/api/metrics/query"
+                            "?series=query_probe_total&window_s=120"
+                            "&agg=sum&labels=kind=q")
+        assert status == 200
+        data = json.loads(body)
+        if data.get("results") and data["results"][0]["value"]:
+            break
+        time.sleep(1.0)
+    assert data.get("matched", 0) >= 1, data
+    assert data["results"][0]["value"] == 30.0, data
+
+    # The raylet's own runtime gauges arrive through the same pipe;
+    # raw returns per-series points labeled node_id/role at ingest.
+    status, body = _get(base + "/api/metrics/query"
+                        "?series=ray_tpu_resource_available"
+                        "&window_s=120&agg=raw")
+    assert status == 200
+    data = json.loads(body)
+    assert data["matched"] >= 1, data
+    rows = data["results"]
+    assert any(r["points"] for r in rows), rows
+    assert all("node_id" in r["labels"] for r in rows), rows
+    assert any(r["labels"].get("role") == "raylet" for r in rows), rows
+
+    # group_by folds the label space server-side.
+    status, body = _get(base + "/api/metrics/query"
+                        "?series=query_probe_total&window_s=120"
+                        "&agg=rate&group_by=kind")
+    assert status == 200
+    data = json.loads(body)
+    assert any(r["labels"].get("kind") == "q" and r["value"] > 0
+               for r in data["results"]), data
+
+    # series= is mandatory.
+    status, body = _get(base + "/api/metrics/query")
+    assert json.loads(body).get("error")
+
+
+def test_timeline_category_pid_filters_and_cap(ray_cluster):
+    """Satellite 2: `/api/timeline` filters by category/pid server-side
+    and caps the non-metadata payload (most recent kept, truncation
+    reported)."""
+    import time
+
+    import ray_tpu
+
+    base = _dashboard_url(ray_tpu)
+
+    @ray_tpu.remote(_metadata={"inline": False})
+    def filter_burst():
+        return 1
+
+    assert all(v == 1 for v in ray_tpu.get(
+        [filter_burst.remote() for _ in range(20)], timeout=120))
+
+    deadline = time.time() + 30
+    body_events = []
+    while time.time() < deadline:
+        status, body = _get(base + "/api/timeline?window_s=120"
+                            "&category=task")
+        assert status == 200
+        trace = json.loads(body)
+        body_events = [e for e in trace["traceEvents"]
+                       if e.get("ph") != "M"]
+        if len(body_events) > 5:
+            break
+        time.sleep(0.5)
+    assert body_events, "no task events after a 20-task burst"
+    assert all(e.get("cat") == "task" for e in body_events), \
+        {e.get("cat") for e in body_events}
+
+    # pid filter narrows to one process (metadata rows stay).
+    pid = body_events[0]["pid"]
+    status, body = _get(base + f"/api/timeline?window_s=120&pid={pid}")
+    assert status == 200
+    trace = json.loads(body)
+    filtered = [e for e in trace["traceEvents"] if e.get("ph") != "M"]
+    assert filtered and all(e["pid"] == pid for e in filtered)
+
+    # Bounded payload: cap at 5 keeps the 5 most recent events and
+    # reports how many were dropped.
+    status, body = _get(base + "/api/timeline?window_s=120"
+                        "&category=task&max_events=5")
+    assert status == 200
+    trace = json.loads(body)
+    capped = [e for e in trace["traceEvents"] if e.get("ph") != "M"]
+    assert len(capped) == 5, len(capped)
+    assert trace.get("truncated_events", 0) >= len(body_events) - 5 > 0
+    assert all(e.get("ph") == "M" or e.get("cat") == "task"
+               for e in trace["traceEvents"])
+
+
+def test_slo_pages_under_overload_and_burns_on_timeline(ray_cluster):
+    """ISSUE 17 acceptance: a declared latency SLO transitions to
+    `page` under a deliberately overloaded engine, visible at
+    `/api/slo`, and the transition lands as a `slo.burn` event on the
+    merged `/api/timeline`."""
+    import time
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    base = _dashboard_url(ray_tpu)
+    rt = ray_tpu.core.worker.current_runtime()
+    try:
+        @serve.deployment
+        class Slow:
+            def __call__(self, payload):
+                time.sleep(0.02)  # every request busts the 0.5ms SLO
+                return {"ok": True}
+
+        serve.run(Slow.bind(), name="slow", route_prefix="/slow")
+        port = serve.start()
+
+        # p99 < 0.5 ms over 30 s: impossible for a 20 ms handler, so
+        # the error budget burns at 100x (page needs >= 10x in both
+        # the 30 s and the 2.5 s window).
+        rt._loop.run(rt._gcs.register_slo({
+            "name": "slow_latency",
+            "objective": "latency_quantile",
+            "series": "serve_deployment_processing_latency_seconds",
+            "labels": {"deployment": "Slow"},
+            "q": 0.99, "threshold_s": 0.0005, "window_s": 30.0,
+        }), timeout=30)
+
+        deadline = time.time() + 90
+        row = {}
+        while time.time() < deadline:
+            # Keep the overload current: the short burn window needs
+            # observations from the last couple of seconds.
+            for _ in range(3):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/slow", timeout=60) as r:
+                    assert r.status == 200
+            status, body = _get(base + "/api/slo")
+            assert status == 200
+            rows = {r["name"]: r for r in json.loads(body)}
+            row = rows.get("slow_latency", {})
+            if row.get("state") == "page":
+                break
+            time.sleep(1.0)
+        assert row.get("state") == "page", row
+        assert row["burn_long"] >= 10.0 and row["burn_short"] >= 10.0
+        assert row["window_events"] > 0
+        assert row["current_quantile_s"] is None \
+            or row["current_quantile_s"] > 0.0005
+
+        # The ok->page transition fired a slo.burn flight event in the
+        # GCS ring; the merged timeline carries it under category=slo.
+        deadline = time.time() + 30
+        burns = []
+        while time.time() < deadline:
+            status, body = _get(base + "/api/timeline?window_s=300"
+                                "&category=slo")
+            assert status == 200
+            trace = json.loads(body)
+            burns = [e for e in trace["traceEvents"]
+                     if e.get("ph") != "M" and e["name"] == "slo.burn"]
+            if burns:
+                break
+            time.sleep(0.5)
+        assert burns, "slo.burn never surfaced on /api/timeline"
+        assert any("slow_latency" in (e.get("args", {}).get("arg") or "")
+                   for e in burns), burns
+    finally:
+        try:
+            rt._loop.run(rt._gcs.remove_slo("slow_latency"), timeout=10)
+        except Exception:
+            pass
+        serve.shutdown()
+
+
+def _profiled_train_loop(config):
+    from ray_tpu import train
+
+    for _ in range(config["steps"]):
+        train.report({"loss": 0.5})
+
+
+def test_train_profile_capture_and_endpoint(ray_cluster, tmp_path):
+    """Satellite 1: TrainConfig(profile_steps=(a, b)) captures a
+    jax.profiler trace on the worker; the trace dir is published and
+    listed at `/api/train/profile` and linked from `/api/train`."""
+    import os
+    import time
+
+    import ray_tpu
+    from ray_tpu.train import (JaxConfig, JaxTrainer, RunConfig,
+                               ScalingConfig, TrainConfig)
+
+    base = _dashboard_url(ray_tpu)
+    profile_dir = str(tmp_path / "traces")
+    trainer = JaxTrainer(
+        _profiled_train_loop,
+        train_loop_config={"steps": 3},
+        jax_config=JaxConfig(platform="cpu"),
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="profile_probe",
+                             storage_path="/tmp/rt_train_prof"),
+        train_config=TrainConfig(profile_steps=(1, 2),
+                                 profile_dir=profile_dir))
+    result = trainer.fit()
+    assert result.error is None
+
+    deadline = time.time() + 30
+    mine = []
+    while time.time() < deadline:
+        status, body = _get(base + "/api/train/profile")
+        assert status == 200
+        mine = [r for r in json.loads(body)
+                if r.get("trial") == "profile_probe"]
+        if mine:
+            break
+        time.sleep(0.5)
+    assert mine, "published profile never listed"
+    row = mine[0]
+    assert row["rank"] == 0 and row["steps"] == [1, 2]
+    # Single-box test cluster: the worker's trace dir is local —
+    # jax.profiler wrote actual artifacts into it.
+    assert row["trace_dir"].startswith(profile_dir)
+    assert os.path.isdir(row["trace_dir"])
+    found = []
+    for root, _dirs, files in os.walk(row["trace_dir"]):
+        found.extend(files)
+    assert found, f"empty trace dir {row['trace_dir']}"
+
+    # The train pane folds the link in.
+    status, body = _get(base + "/api/train")
+    assert status == 200
+    trial = json.loads(body)["trials"].get("profile_probe")
+    assert trial is not None
+    profs = trial.get("profiles", [])
+    assert profs and profs[0]["trace_dir"] == row["trace_dir"]

@@ -1,0 +1,359 @@
+"""Round-17 metrics pipeline: delta encoding, retention/query engine,
+SLO burn-rate state machine, and metrics continuity across a GCS
+kill -9 (ISSUE 17 satellite: pushes re-register, the retention ring
+survives only as WAL-acked series metadata, and no duplicate series
+appear after restart).
+
+Everything here is in-process: the Recorder and MetricsStore are pure
+data structures, and the continuity scenario runs the real GcsServer
+under the simulated-raylet harness (core/simcluster.py).
+"""
+
+import asyncio
+
+import pytest
+
+pytestmark = pytest.mark.unit
+
+
+def _run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+# ---------------------------------------------------------------------------
+# Recorder: delta encoding + bounded pending ring
+# ---------------------------------------------------------------------------
+
+def _snap(counter=0.0, gauge=None, hist=None):
+    """A registry-shaped snapshot with one counter (+ optional gauge /
+    histogram)."""
+    out = [{
+        "name": "t_events_total", "type": "counter",
+        "help": "test counter",
+        "samples": [{"tags": {"kind": "a"}, "value": counter}],
+    }]
+    if gauge is not None:
+        out.append({
+            "name": "t_level", "type": "gauge", "help": "test gauge",
+            "samples": [{"tags": {}, "value": gauge}],
+        })
+    if hist is not None:
+        buckets, total, count = hist
+        out.append({
+            "name": "t_latency_seconds", "type": "histogram",
+            "help": "test histogram",
+            "samples": [{"tags": {}, "buckets": buckets, "sum": total,
+                         "count": count,
+                         "boundaries": [0.01, 0.1, 1.0]}],
+        })
+    return out
+
+
+def test_recorder_first_capture_ships_full_value_then_deltas():
+    from ray_tpu.core.metrics_ts import Recorder
+
+    r = Recorder(capacity=16)
+    assert r.capture(_snap(counter=5.0, gauge=2.0,
+                           hist=([1, 0, 0], 0.005, 1)), t=1.0)
+    first = r.pending()[0]["series"]
+    by_name = {e[0]: e for e in first}
+    # Full running values on first sight, with help as the 5th element.
+    assert by_name["t_events_total"][3] == 5.0
+    assert by_name["t_events_total"][4] == "test counter"
+    assert by_name["t_level"][3] == 2.0
+    hist = by_name["t_latency_seconds"][3]
+    assert hist[0] == [1, 0, 0] and hist[2] == 1
+    assert hist[3] == [0.01, 0.1, 1.0]  # boundaries ride every payload
+
+    # Second capture: increments only, no help element.
+    assert r.capture(_snap(counter=8.0, gauge=2.0,
+                           hist=([1, 2, 0], 0.205, 3)), t=2.0)
+    second = r.pending()[1]["series"]
+    by_name = {e[0]: e for e in second}
+    assert by_name["t_events_total"][3] == 3.0
+    assert len(by_name["t_events_total"]) == 4
+    assert by_name["t_latency_seconds"][3][0] == [0, 2, 0]
+    assert by_name["t_latency_seconds"][3][2] == 2
+    # The unchanged gauge shipped nothing.
+    assert "t_level" not in by_name
+
+    # Nothing moved at all -> no entry queued.
+    assert not r.capture(_snap(counter=8.0, gauge=2.0,
+                               hist=([1, 2, 0], 0.205, 3)), t=3.0)
+    assert len(r.pending()) == 2
+
+
+def test_recorder_ring_bounds_and_ack():
+    from ray_tpu.core.metrics_ts import Recorder
+
+    r = Recorder(capacity=3)
+    for i in range(6):
+        r.capture(_snap(counter=float(i + 1)), t=float(i))
+    pend = r.pending()
+    assert len(pend) == 3
+    assert r.dropped == 3
+    assert pend[0]["t"] == 3.0  # oldest evicted first
+    r.ack(2)
+    assert len(r.pending()) == 1
+    # Ack of entries appended after the shipped snapshot must not eat
+    # them: ack(n) only drops the oldest n.
+    r.capture(_snap(counter=100.0), t=9.0)
+    r.ack(1)
+    assert [e["t"] for e in r.pending()] == [9.0]
+
+
+def test_series_key_is_label_order_independent():
+    from ray_tpu.core.metrics_ts import series_key
+
+    assert series_key("m", {"b": "2", "a": "1"}) == \
+        series_key("m", {"a": "1", "b": "2"})
+    assert series_key("m", {"a": "1"}) != series_key("m", {"a": "2"})
+
+
+# ---------------------------------------------------------------------------
+# MetricsStore: ingest, fold, query engine
+# ---------------------------------------------------------------------------
+
+def _store():
+    from ray_tpu.core.gcs.metrics_store import MetricsStore
+
+    return MetricsStore(max_series=100, points=64)
+
+
+def _batch(t, series):
+    return [{"t": t, "series": series}]
+
+
+def test_store_cumulative_fold_and_prometheus_exposition():
+    from ray_tpu.util.metrics import render_prometheus
+
+    store = _store()
+    store.ingest(_batch(10.0, [
+        ["req_total", "counter", {"role": "worker"}, 5.0, "requests"],
+        ["queue_depth", "gauge", {}, 3.0, "depth"],
+        ["lat_seconds", "histogram", {},
+         [[2, 1, 0], 0.3, 3, [0.01, 0.1, 1.0]], "latency"],
+    ]), extra_labels={"node_id": "n1"})
+    store.ingest(_batch(20.0, [
+        ["req_total", "counter", {"role": "worker"}, 4.0],
+        ["queue_depth", "gauge", {}, 7.0],
+        ["lat_seconds", "histogram", {},
+         [[0, 0, 1], 0.9, 1, [0.01, 0.1, 1.0]]],
+    ]), extra_labels={"node_id": "n1"})
+
+    fold = {m["name"]: m for m in store.latest_fold()}
+    assert fold["req_total"]["samples"][0]["value"] == 9.0
+    assert fold["queue_depth"]["samples"][0]["value"] == 7.0
+    h = fold["lat_seconds"]["samples"][0]
+    assert h["buckets"] == [2, 1, 1] and h["count"] == 4
+
+    text = render_prometheus(store.latest_fold())
+    assert 'req_total{node_id="n1",role="worker"} 9.0' in text
+    assert "# TYPE lat_seconds histogram" in text
+    assert 'le="+Inf"' in text
+
+
+def test_store_rate_quantile_and_group_by():
+    store = _store()
+    for t in (100.0, 110.0, 120.0):
+        store.ingest(_batch(t, [
+            ["rq_total", "counter", {"node_id": "n1"}, 10.0],
+            ["rq_total", "counter", {"node_id": "n2"}, 20.0],
+            ["rq_lat", "histogram", {"node_id": "n1"},
+             [[5, 5, 0], 1.0, 10, [0.01, 0.1, 1.0]]],
+        ]))
+    # rate over a 30s window that covers all three pushes
+    r = store.query("rq_total", window_s=30.0, agg="rate", now=125.0)
+    assert r["matched"] == 2
+    assert r["results"][0]["value"] == pytest.approx(90.0 / 30.0)
+    # group_by keeps the per-node split
+    g = store.query("rq_total", window_s=30.0, agg="rate",
+                    group_by=["node_id"], now=125.0)
+    by_node = {row["labels"]["node_id"]: row["value"]
+               for row in g["results"]}
+    assert by_node["n1"] == pytest.approx(1.0)
+    assert by_node["n2"] == pytest.approx(2.0)
+    # quantile-over-time on pushed buckets: 15/30 obs <= 0.01,
+    # 30/30 <= 0.1 -> p90 lands in the second bucket.
+    q = store.query("rq_lat", window_s=30.0, agg="p90", now=125.0)
+    assert q["value"] == 0.1
+    assert q["count"] == 30
+    # a window past the ring's points sees nothing
+    assert store.query("rq_total", window_s=1.0, agg="rate",
+                       now=500.0)["results"][0]["value"] == 0.0
+
+
+def test_store_cardinality_cap():
+    from ray_tpu.core.gcs.metrics_store import MetricsStore
+
+    store = MetricsStore(max_series=2, points=8)
+    store.ingest(_batch(1.0, [
+        ["a", "counter", {"i": "1"}, 1.0],
+        ["a", "counter", {"i": "2"}, 1.0],
+        ["a", "counter", {"i": "3"}, 1.0],
+    ]))
+    assert len(store.series) == 2
+    assert store.dropped_series == 1
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate state machine
+# ---------------------------------------------------------------------------
+
+def test_slo_latency_quantile_pages_and_recovers():
+    from ray_tpu.core.gcs.metrics_store import SloTracker
+
+    store = _store()
+    transitions = []
+    slo = SloTracker(
+        on_transition=lambda n, o, new, burn: transitions.append((o, new)))
+    slo.register({"name": "lat", "objective": "latency_quantile",
+                  "series": "rq_lat", "q": 0.9, "threshold_s": 0.01,
+                  "window_s": 60.0})
+
+    # Healthy: everything in the <=0.01 bucket -> ok.
+    store.ingest(_batch(10.0, [
+        ["rq_lat", "histogram", {}, [[10, 0, 0], 0.05, 10,
+                                     [0.01, 0.1, 1.0]]]]))
+    assert slo.evaluate(store, now=11.0) == []
+    assert slo.state["lat"]["state"] == "ok"
+
+    # Overload (the healthy batch has aged out of the 60s window by
+    # t=102): every observation above the threshold. Error fraction
+    # 1.0 against a 0.1 budget = burn 10 in both windows -> page.
+    store.ingest(_batch(102.0, [
+        ["rq_lat", "histogram", {}, [[0, 0, 50], 80.0, 50,
+                                     [0.01, 0.1, 1.0]]]]))
+    assert slo.evaluate(store, now=103.0) == [("lat", "ok", "page")]
+    st = slo.state["lat"]
+    assert st["state"] == "page"
+    assert st["burn_long"] >= 10.0 and st["burn_short"] >= 10.0
+
+    # Burn stops: evaluating far past the window drains both windows.
+    assert slo.evaluate(store, now=500.0) == [("lat", "page", "ok")]
+    assert transitions == [("ok", "page"), ("page", "ok")]
+
+    status = slo.status(store)
+    assert status[0]["name"] == "lat"
+    assert status[0]["transitions"] == 2
+
+
+def test_slo_error_ratio_and_spec_validation():
+    from ray_tpu.core.gcs.metrics_store import SloTracker
+
+    store = _store()
+    slo = SloTracker()
+    slo.register({"name": "err", "objective": "error_ratio",
+                  "bad_series": "fail_total", "total_series": "req_total",
+                  "max_ratio": 0.01, "window_s": 60.0})
+    # 50% failures against a 1% budget -> burn 50 -> page.
+    store.ingest(_batch(10.0, [
+        ["fail_total", "counter", {}, 50.0],
+        ["req_total", "counter", {}, 100.0]]))
+    assert slo.evaluate(store, now=11.0) == [("err", "ok", "page")]
+
+    with pytest.raises(ValueError):
+        slo.register({"name": "bad", "objective": "latency_quantile",
+                      "series": "x"})  # no threshold
+    with pytest.raises(ValueError):
+        slo.register({"objective": "error_ratio"})  # no name
+
+
+# ---------------------------------------------------------------------------
+# Continuity across GCS kill -9 (simcluster)
+# ---------------------------------------------------------------------------
+
+def test_metrics_continuity_across_gcs_restart(tmp_path):
+    """WAL-acked series metadata survives a kill -9; ring data does
+    not; re-pushed series land on their recovered identity with no
+    duplicates; an unacked series registration dies with the process."""
+    from ray_tpu.core.metrics_ts import series_key
+    from ray_tpu.core.simcluster import SimCluster
+
+    async def scenario():
+        cluster = SimCluster(3, seed=7,
+                             storage_path=str(tmp_path / "gcs"))
+        await cluster.start()
+        try:
+            r0 = cluster.raylets["simnode0000"]
+            acked = [{"t": 1.0, "series": [
+                ["cont_total", "counter", {"role": "raylet"}, 5.0,
+                 "continuity counter"]]}]
+            await r0._gcs.heartbeat(r0.node_id, r0.resources_available,
+                                    load={"pending": 0}, metrics=acked)
+            key = series_key("cont_total",
+                             {"role": "raylet",
+                              "node_id": r0.node_id[:8]})
+            assert key in cluster.gcs.metrics.series
+            await cluster.gcs.flush_now()  # WAL-ack the metadata
+
+            # A second series lands AFTER the flush and dies with the
+            # process (its registration never reached the WAL).
+            await r0._gcs.heartbeat(
+                r0.node_id, r0.resources_available, load={"pending": 0},
+                metrics=[{"t": 2.0, "series": [
+                    ["unacked_total", "counter", {}, 1.0, "unacked"]]}])
+            assert any(s.meta["name"] == "unacked_total"
+                       for s in cluster.gcs.metrics.series.values())
+
+            cluster.kill_gcs()
+            await cluster.restart_gcs()
+
+            store = cluster.gcs.metrics
+            # Metadata recovered, ring empty: identity survived, data
+            # did not -- so the fold (which skips empty rings) is clean.
+            assert key in store.series
+            assert len(store.series[key].ring) == 0
+            assert not any(s.meta["name"] == "unacked_total"
+                           for s in store.series.values())
+            assert all(m["name"] != "cont_total"
+                       for m in store.latest_fold())
+
+            # Re-push: lands on the recovered identity -- no duplicate
+            # series, and the cumulative total restarts from increments
+            # (Prometheus counter-reset semantics).
+            n_before = len(store.series)
+            await r0._gcs.heartbeat(
+                r0.node_id, r0.resources_available, load={"pending": 0},
+                metrics=[{"t": 3.0, "series": [
+                    ["cont_total", "counter", {"role": "raylet"}, 2.0]]}])
+            assert len(store.series) == n_before
+            assert store.series[key].counter_total == 2.0
+            fold = {m["name"]: m for m in store.latest_fold()}
+            assert fold["cont_total"]["samples"][0]["value"] == 2.0
+        finally:
+            await cluster.stop()
+
+    _run(scenario())
+
+
+def test_slo_specs_survive_gcs_restart(tmp_path):
+    """register_slo is write-through: the objective (and evaluation)
+    must come back after a kill -9."""
+    from ray_tpu.core.simcluster import SimCluster
+
+    async def scenario():
+        cluster = SimCluster(2, seed=11,
+                             storage_path=str(tmp_path / "gcs"))
+        await cluster.start()
+        try:
+            r0 = cluster.raylets["simnode0000"]
+            spec = {"name": "errs", "objective": "error_ratio",
+                    "bad_series": "f_total", "total_series": "r_total",
+                    "max_ratio": 0.01, "window_s": 60.0}
+            await r0._gcs.register_slo(spec)
+            assert "errs" in cluster.gcs.slo.slos
+
+            cluster.kill_gcs()
+            await cluster.restart_gcs()
+            assert "errs" in cluster.gcs.slo.slos
+
+            rows = await r0._gcs.get_slo()
+            assert rows and rows[0]["name"] == "errs"
+            assert rows[0]["state"] == "ok"
+            assert await r0._gcs.remove_slo("errs") is True
+            assert "errs" not in cluster.gcs.slo.slos
+        finally:
+            await cluster.stop()
+
+    _run(scenario())
