@@ -126,6 +126,19 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         notes["simcluster_bench_error"] = repr(e)
     try:
+        # HA control plane (round 18): leader kill -9 -> first
+        # quorum-acked write failover latency, replicated write-through
+        # throughput, elections and replication lag on a 3-replica GCS
+        # — the availability metrics next to the restart-time one.
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.perf", "--ha",
+             "--scale", "0.5"],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        notes["ha"] = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        notes["ha_bench_error"] = repr(e)
+    try:
         # LLM-serving scenario (continuous-batching engine): sustained
         # tokens/s vs the static-batching baseline on the same mixed
         # workload, TTFT, shed-mode p99 under 2x overload, and the

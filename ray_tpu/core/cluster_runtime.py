@@ -787,7 +787,10 @@ class ClusterRuntime:
         if address.startswith("ray://"):
             address = address[len("ray://"):]
         # Connect to an existing cluster: find this machine's raylet (or the
-        # head raylet) from the GCS node table.
+        # head raylet) from the GCS node table. `address` may be an HA
+        # replica set ("host:p0,host:p1,host:p2"): the probe and every
+        # client built from it rotate the set and follow NOT_LEADER
+        # redirects onto whichever replica currently leads.
         probe = GcsClient(address)
         loop = EventLoopThread(name="probe")
         try:

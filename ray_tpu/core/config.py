@@ -111,6 +111,19 @@ _D("gcs_restart_node_grace_ms", 0,
    "to find the restarted server before the health loop may judge it. "
    "0 = derive from health_check_period_ms * health_check_failure_"
    "threshold.")
+_D("gcs_ha_lease_ms", 1500.0,
+   "HA GCS leadership lease. A follower that hears nothing from the "
+   "leader for lease * (1 + jitter) stands for election; a leader that "
+   "cannot reach a quorum for a full lease steps down. Bounds failover "
+   "time from below (a kill -9'd leader is replaced within roughly one "
+   "jittered lease) and stale-leader serving time from above.")
+_D("gcs_ha_renew_ms", 500.0,
+   "How often the HA GCS leader renews its lease (heartbeats the "
+   "replicas over the same RPC plane the WAL replicates on). Keep well "
+   "under gcs_ha_lease_ms (classic rule: a third).")
+_D("gcs_ha_replicate_timeout_ms", 2000.0,
+   "Per-peer timeout for one replication/vote RPC. A peer that misses "
+   "it counts as no-ack for that frame (the quorum may still land).")
 _D("owner_unreachable_grace_s", 5.0,
    "How long a borrower-side pull tolerates an unreachable object owner "
    "before declaring the owner dead: within the grace the pull retries "

@@ -147,6 +147,19 @@ class FaultPlan:
         heal with `plan.heal(rule)`."""
         return self._add(_Rule("partition", src, dst, "*", p=1.0))
 
+    def isolate(self, node: str,
+                peers: Optional[List[str]] = None) -> List[_Rule]:
+        """Two-way cut: `node` can neither reach nor be reached by each
+        of `peers` (default: everyone). The building block for HA GCS
+        partition scenarios — a minority-partitioned replica must stop
+        winning elections, not just stop hearing the leader. Heal each
+        returned rule to reconnect."""
+        out: List[_Rule] = []
+        for p in (list(peers) if peers else ["*"]):
+            out.append(self.partition(node, p))
+            out.append(self.partition(p, node))
+        return out
+
     def heal(self, rule: _Rule) -> None:
         rule.active = False
 

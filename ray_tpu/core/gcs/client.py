@@ -10,7 +10,7 @@ import asyncio
 import logging
 from typing import Any, Callable, Dict, List, Optional
 
-from ray_tpu.core.rpc import ConnectionLost, RpcClient
+from ray_tpu.core.rpc import ConnectionLost, RpcClient, RpcError
 
 logger = logging.getLogger(__name__)
 
@@ -46,14 +46,24 @@ class _ReconnectingRpc:
     server, `gcs_client` retry machinery + `redis_store_client.h`
     persistence on the server side).
 
-    On ConnectionLost: reconnect to the same address within the
-    `gcs_rpc_timeout_s` window, re-attach push handlers, re-issue
-    channel subscriptions, then retry the call once. GCS table ops are
-    keyed/overwriting (idempotent), so a single retry is safe."""
+    On ConnectionLost: reconnect within the `gcs_rpc_timeout_s` window,
+    re-attach push handlers, re-issue channel subscriptions, then retry
+    the call once. GCS table ops are keyed/overwriting (idempotent), so
+    a single retry is safe.
+
+    HA (round 18): `address` may be a comma-separated replica set. The
+    target is RE-RESOLVED on every reconnect attempt (never bound at
+    construction — a moved or failed-over GCS used to be unreachable
+    forever), rotating the set and preferring the leader hint carried by
+    `NotLeaderError` redirects, so a raylet/driver rides its ordinary
+    jittered-backoff path onto whichever replica wins the election."""
 
     def __init__(self, address: str):
-        self.address = address
-        self._client = RpcClient(address)
+        self.addresses = [a.strip() for a in address.split(",")
+                          if a.strip()]
+        self.address = self.addresses[0]  # current target
+        self._leader_hint: Optional[str] = None
+        self._client = RpcClient(self.address)
         self._push_handlers: Dict[str, Callable] = {}
         self._subscribed: set = set()
         self._reconnect_lock: Optional[asyncio.Lock] = None
@@ -66,7 +76,22 @@ class _ReconnectingRpc:
 
     async def connect(self, timeout: float = 10.0) -> None:
         self._reconnect_lock = asyncio.Lock()
-        await self._client.connect(timeout=timeout)
+        last_err: Optional[Exception] = None
+        for i, addr in enumerate(self.addresses):
+            client = RpcClient(addr)
+            try:
+                await client.connect(timeout=timeout)
+                self.address = addr
+                self._client = client
+                break
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+                try:
+                    await client.close()
+                except Exception:
+                    pass
+                if i == len(self.addresses) - 1:
+                    raise last_err
         try:
             self._cluster_id = await self._client.call("cluster_id",
                                                        timeout=10.0)
@@ -91,7 +116,78 @@ class _ReconnectingRpc:
             if self._closed:
                 raise
             await self._reconnect()
-            return await self._client.call(method, **kwargs)
+            return await self._redirect_aware_call(method, kwargs)
+        except RpcError as e:
+            if self._closed or not self._note_redirect(e):
+                raise
+            return await self._redirect_aware_call(method, kwargs)
+
+    def _note_redirect(self, err: Exception) -> bool:
+        """Record the leader hint from a NOT_LEADER error string (the
+        follower's NotLeaderError crosses the wire as a plain handler
+        error). True if this was a redirect. A QuorumLostError is
+        retryable the same way: the replica we reached cannot commit
+        right now (minority side of a partition) — rotate and let
+        whoever leads next serve the retry."""
+        from ray_tpu.core.gcs.replication import parse_not_leader
+
+        if "QuorumLostError" in str(err):
+            self._leader_hint = None
+            return True
+        hint = parse_not_leader(str(err))
+        if hint is None:
+            return False
+        leader = hint.get("leader")
+        if leader and leader != self.address:
+            self._leader_hint = leader
+        return True
+
+    async def _redirect_aware_call(self, method: str,
+                                   kwargs: Dict[str, Any]) -> Any:
+        """Retry loop after a reconnect or redirect: follow NOT_LEADER
+        hints (switching replicas) within the gcs_rpc_timeout_s window.
+        A vacant leadership (election in progress) shows up as repeated
+        redirects-with-no-hint and is ridden out on the same jittered
+        backoff the reconnect path uses."""
+        from ray_tpu.core.config import ray_config
+
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + ray_config().gcs_rpc_timeout_s
+        attempt = 0
+        while True:
+            if self._leader_hint and self._leader_hint != self.address:
+                # A redirect told us who leads: drop the current replica
+                # and let _reconnect dial the hint.
+                try:
+                    await self._client.close()
+                except Exception:
+                    pass
+                await self._reconnect()
+            try:
+                return await self._client.call(method, **kwargs)
+            except ConnectionLost:
+                if self._closed or loop.time() >= deadline:
+                    raise
+                await self._reconnect()
+            except RpcError as e:
+                if (self._closed or not self._note_redirect(e)
+                        or loop.time() >= deadline):
+                    raise
+                if not self._leader_hint:
+                    await asyncio.sleep(backoff_delay(attempt))
+            attempt += 1
+
+    def _resolve_target(self, attempt: int) -> str:
+        """Pick the address for THIS reconnect attempt. Re-resolving
+        per attempt (instead of binding at construction) is what lets a
+        client follow a GCS that moved or failed over: prefer the last
+        NOT_LEADER hint, otherwise rotate the replica set."""
+        if self._leader_hint:
+            hint, self._leader_hint = self._leader_hint, None
+            if hint not in self.addresses:
+                self.addresses.append(hint)
+            return hint
+        return self.addresses[attempt % len(self.addresses)]
 
     async def _reconnect(self) -> None:
         from ray_tpu.core import flight
@@ -106,7 +202,8 @@ class _ReconnectingRpc:
             last_err: Optional[Exception] = None
             attempt = 0
             while loop.time() < deadline:
-                fresh = RpcClient(self.address)
+                target = self._resolve_target(attempt)
+                fresh = RpcClient(target)
                 try:
                     if flight.enabled:
                         flight.instant("gcs", "gcs.retry", arg=attempt)
@@ -120,11 +217,12 @@ class _ReconnectingRpc:
                         cid = await fresh.call("cluster_id", timeout=5.0)
                         if cid != self._cluster_id:
                             raise ConnectionLost(
-                                f"{self.address} now serves a different "
+                                f"{target} now serves a different "
                                 f"cluster ({cid[:8]}…)")
                     for ch, h in self._push_handlers.items():
                         fresh.on_push(ch, h)
                     old, self._client = self._client, fresh
+                    self.address = target
                     try:
                         await old.close()
                     except Exception:
@@ -132,7 +230,7 @@ class _ReconnectingRpc:
                     for ch in self._subscribed:
                         await fresh.call("subscribe", channel=ch)
                     logger.info("reconnected to GCS at %s (attempt %d)",
-                                self.address, attempt)
+                                target, attempt)
                     if flight.enabled:
                         flight.instant("gcs", "gcs.reconnect", arg=attempt)
                     return
@@ -149,16 +247,18 @@ class _ReconnectingRpc:
                     await asyncio.sleep(backoff_delay(attempt))
                     attempt += 1
             raise ConnectionLost(
-                f"GCS at {self.address} unreachable for {window}s "
-                f"({attempt} attempts): {last_err}")
+                f"GCS at {','.join(self.addresses)} unreachable for "
+                f"{window}s ({attempt} attempts): {last_err}")
 
 
 class GcsClient:
     def __init__(self, address: str, rpc: Optional[Any] = None):
-        # `rpc` is injectable so core/simcluster.py can bind the SAME
-        # typed accessors to an in-process loopback channel: the sim's
-        # raylets speak to the real GcsServer through the real client
-        # code, minus the TCP socket.
+        # `address` may be a comma-separated HA replica set; the
+        # reconnecting facade rotates it and follows NOT_LEADER
+        # redirects. `rpc` is injectable so core/simcluster.py can bind
+        # the SAME typed accessors to an in-process loopback channel:
+        # the sim's raylets speak to the real GcsServer through the real
+        # client code, minus the TCP socket.
         self.rpc = rpc if rpc is not None else _ReconnectingRpc(address)
 
     async def connect(self, timeout: float = 10.0) -> None:
@@ -212,17 +312,21 @@ class GcsClient:
     async def heartbeat(self, node_id: str,
                         resources_available: Dict[str, float],
                         load: Optional[dict] = None,
-                        metrics: Optional[List[dict]] = None) -> bool:
+                        metrics: Optional[List[dict]] = None,
+                        workers: Optional[List[dict]] = None) -> bool:
         """False = the GCS does not recognize this node (it restarted or
         declared the node dead): the caller must re-register.
 
         `metrics` is the node's coalesced metrics-pipeline batch (round
-        17): piggybacking it here keeps the fleet at one push RPC per
-        node per interval."""
+        17) and `workers` the node's batched per-worker state (round 18):
+        piggybacking both here keeps the fleet at one push RPC per node
+        per interval regardless of worker count, and keeps worker churn
+        off the quorum-replicated write path (it lands as GCS soft
+        state)."""
         return await self.rpc.call(
             "heartbeat", node_id=node_id,
             resources_available=resources_available, load=load,
-            metrics=metrics, timeout=5.0)
+            metrics=metrics, workers=workers, timeout=5.0)
 
     async def get_nodes(self) -> List[Dict[str, Any]]:
         return await self.rpc.call("get_nodes")

@@ -1,0 +1,544 @@
+"""HA GCS: Raft-lite replication of the GCS write-ahead log.
+
+Reference shape: the upstream GCS delegates fault tolerance to an
+external Redis (PAPER.md layer 2). Here replication is built natively on
+the WAL the GCS already writes (gcs/server.py `flush_now`): N replicas
+each run the full `GcsServer` store, the leader appends every
+write-through frame to a quorum of followers before acking, and
+leadership is a term-numbered lease renewed over the same RPC plane.
+
+Raft-lite, deliberately smaller than Raft:
+
+- The replicated log IS the existing WAL frame stream. Records are
+  absolute `(table, key, present, value)` cells, so re-applying a frame
+  is idempotent and followers never need log truncation/rollback — a
+  frame that reached a quorum is never reordered because exactly one
+  leader per term produces frames (vote safety), and a frame that missed
+  quorum is simply re-sent (possibly with a superset of cells) at the
+  same index.
+- Elections fire on lease expiry; the vote criterion is log completeness
+  (`(last_term, last_index)` at least as new as the voter's), so a
+  follower missing an acked write can never win — "no acked write
+  forgotten" across failover.
+- Catch-up is a full-state snapshot install (the persisted tables are
+  small — control-plane metadata, not data plane), not incremental log
+  shipping.
+
+Followers redirect every non-replication RPC with a typed
+`NotLeaderError` carrying a leader hint; `gcs/client.py` and the
+simcluster channel parse it out of the standard error string and
+re-resolve, so clients ride the existing jittered-backoff reconnect path
+onto the new leader with no new wire machinery.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import re
+import time
+from typing import Any, Awaitable, Callable, Dict, List, Optional
+
+from ray_tpu.core.config import ray_config
+
+logger = logging.getLogger(__name__)
+
+
+class NotLeaderError(RuntimeError):
+    """Raised by a follower replica for any RPC only the leader may
+    serve. Crosses the wire as the standard handler-error string
+    ("NotLeaderError: leader=gcs1 term=3"); `parse_not_leader` recovers
+    the redirect hint on the client side."""
+
+    def __init__(self, leader_hint: Optional[str] = None, term: int = 0):
+        self.leader_hint = leader_hint
+        self.term = term
+        super().__init__(f"leader={leader_hint or '?'} term={term}")
+
+
+_NOT_LEADER_RE = re.compile(
+    r"NotLeaderError\b.*?leader=(\S+)\s+term=(\d+)")
+
+
+def parse_not_leader(text: Any) -> Optional[Dict[str, Any]]:
+    """Recover the redirect hint from an RpcError string. Returns
+    {"leader": addr-or-None, "term": int} or None if the error is not a
+    NOT_LEADER redirect."""
+    m = _NOT_LEADER_RE.search(str(text or ""))
+    if not m:
+        return None
+    leader = m.group(1)
+    return {"leader": None if leader == "?" else leader,
+            "term": int(m.group(2))}
+
+
+class QuorumLostError(RuntimeError):
+    """A write-through frame could not reach a majority: the mutation
+    fails (and is retried by the client against whoever leads next)
+    rather than acking a write only this replica remembers."""
+
+
+class Replication:
+    """Per-replica consensus state, owned by a `GcsServer`.
+
+    `peer_call(peer_id, method, **kwargs)` is the outbound RPC: the
+    simcluster injects its fault-planned dispatch; production dials
+    RpcClients from the `peers` id->address map. `address_of(peer_id)`
+    renders the redirect hint clients dial (replica ids in the sim,
+    host:port in production).
+    """
+
+    def __init__(self, server: Any, self_id: str, peers: List[str], *,
+                 peer_call: Optional[Callable[..., Awaitable[Any]]] = None,
+                 peer_addrs: Optional[Dict[str, str]] = None,
+                 address_of: Optional[Callable[[str], str]] = None,
+                 rng: Optional[random.Random] = None):
+        self.server = server
+        self.self_id = self_id
+        self.peers = [p for p in peers if p != self_id]
+        self.cluster_size = len(self.peers) + 1
+        self.quorum = self.cluster_size // 2 + 1
+        # -- consensus state ------------------------------------------
+        self.term = 0
+        self.role = "follower"           # follower | candidate | leader
+        self.leader_id: Optional[str] = None
+        self.voted_for: Dict[int, str] = {}
+        self.last_index = 0              # last quorum-committed frame
+        self.last_term = 0               # term that produced it
+        # Observed leader per term, merged across replicas by the HA
+        # bench to assert the one-leader-per-term invariant.
+        self.leaders_by_term: Dict[int, str] = {}
+        self.elections = 0               # elections this replica started
+        self.frames_replicated = 0
+        self.match_index: Dict[str, int] = {}  # peer -> confirmed index
+        # -- wiring ---------------------------------------------------
+        self._peer_addrs = dict(peer_addrs or {})
+        self._peer_call = peer_call or self._dial_peer
+        self._address_of = address_of or (
+            lambda pid: self._peer_addrs.get(pid, pid))
+        self._rng = rng or random.Random()
+        self._peer_clients: Dict[str, Any] = {}
+        self._syncing: set = set()  # peers with a catch-up in flight
+        self._renew_tasks: set = set()  # in-flight lease renewals
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+        now = time.monotonic()
+        self._election_deadline = now + self._election_timeout()
+        self._last_quorum_at = now
+
+    # -- lifecycle ----------------------------------------------------
+    @property
+    def active(self) -> bool:
+        return self.cluster_size > 1
+
+    def is_leader(self) -> bool:
+        return self.role == "leader"
+
+    def leader_address(self) -> Optional[str]:
+        if self.leader_id is None:
+            return None
+        if self.leader_id == self.self_id:
+            return self._address_of(self.self_id)
+        return self._address_of(self.leader_id)
+
+    def recover(self) -> None:
+        """Seed (term, index) from the persisted `replication_meta`
+        record the WAL replay restored — a rejoining replica must not
+        vote as if its log were empty."""
+        st = self.server.replication_meta.get("state") or {}
+        self.last_index = int(st.get("index", 0))
+        self.last_term = int(st.get("term", 0))
+        self.term = max(self.term, self.last_term)
+
+    def start(self) -> None:
+        if self._task is None and self.active:
+            self._task = asyncio.ensure_future(self._ticker())
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        # kill -9 semantics: an in-flight renewal must die with the
+        # process, not keep asserting a lease the holder no longer runs.
+        for t in list(self._renew_tasks):
+            t.cancel()
+        self._renew_tasks.clear()
+
+    def status(self) -> Dict[str, Any]:
+        lag = 0
+        if self.is_leader() and self.peers:
+            lag = self.last_index - min(
+                self.match_index.get(p, 0) for p in self.peers)
+        return {
+            "replica_id": self.self_id,
+            "role": self.role,
+            "term": self.term,
+            "leader": self.leader_id,
+            "leader_address": self.leader_address(),
+            "last_index": self.last_index,
+            "replication_lag": lag,
+            "elections": self.elections,
+            "replicas": self.cluster_size,
+            "quorum": self.quorum,
+        }
+
+    # -- timers -------------------------------------------------------
+    def _cfg_s(self, name: str) -> float:
+        return getattr(ray_config(), name) / 1000.0
+
+    def _election_timeout(self) -> float:
+        # Randomized per-attempt spread breaks split votes; seeding the
+        # rng (simcluster does) keeps fault scenarios replayable.
+        return self._cfg_s("gcs_ha_lease_ms") * (1.0 + self._rng.random())
+
+    def _reset_election_deadline(self) -> None:
+        self._election_deadline = time.monotonic() + self._election_timeout()
+
+    async def _ticker(self) -> None:
+        renew_s = self._cfg_s("gcs_ha_renew_ms")
+        while not self._stopped:
+            await asyncio.sleep(renew_s)
+            try:
+                if self.is_leader():
+                    # Fire-and-collect: a partitioned peer's reply
+                    # timeout must not stretch the heartbeat cadence the
+                    # HEALTHY follower observes, or its election
+                    # deadline fires against a live leader and the set
+                    # churns through terms for the partition's lifetime.
+                    t = asyncio.ensure_future(self._renew_guard())
+                    self._renew_tasks.add(t)
+                    t.add_done_callback(self._renew_tasks.discard)
+                elif time.monotonic() >= self._election_deadline:
+                    await self._run_election()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.warning("replication tick failed", exc_info=True)
+
+    async def _renew_guard(self) -> None:
+        try:
+            await self._renew_lease()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.warning("lease renewal failed", exc_info=True)
+
+    # -- role transitions ---------------------------------------------
+    def _become_follower(self, leader: Optional[str] = None) -> None:
+        was_leader = self.role == "leader"
+        self.role = "follower"
+        self.leader_id = leader
+        self._reset_election_deadline()
+        if was_leader:
+            logger.warning("GCS %s stepping down (term %d, new leader %s)",
+                           self.self_id, self.term, leader or "?")
+
+    async def _become_leader(self, term: int) -> None:
+        self.role = "leader"
+        self.leader_id = self.self_id
+        self.leaders_by_term[term] = self.self_id
+        self.match_index = {p: 0 for p in self.peers}
+        self._last_quorum_at = time.monotonic()
+        logger.info("GCS %s elected leader for term %d (log index %d)",
+                    self.self_id, term, self.last_index)
+        from ray_tpu.core import flight
+
+        if flight.enabled:
+            flight.instant("gcs", "gcs.failover",
+                           arg=f"{self.self_id}:term={term}")
+        # Promotion mirrors restart recovery: soft state (heartbeats,
+        # metric identities, SLO watchers, stuck reschedules) rebuilds
+        # through the same contracts a restarted GCS uses.
+        await self.server._on_promoted(term)
+        # Assert the lease immediately so lagging followers stop
+        # standing for election against us.
+        await self._renew_lease()
+
+    # -- leader: lease renewal + replication --------------------------
+    async def _renew_lease(self) -> None:
+        term = self.term
+        replies = await self._broadcast(
+            "replicate_wal", term=term, leader=self.self_id,
+            index=self.last_index, frame=None)
+        acked = 1
+        for peer, r in replies:
+            if r is None:
+                continue
+            if r.get("term", 0) > self.term:
+                self.term = r["term"]
+                self._become_follower()
+                return
+            if r.get("ok"):
+                acked += 1
+                idx = int(r.get("index", 0))
+                self.match_index[peer] = max(
+                    self.match_index.get(peer, 0), idx)
+                if idx < self.last_index:
+                    # Restarted/lagging follower: catch it up from the
+                    # heartbeat, not only on the next write (a quiet
+                    # cluster would otherwise leave it behind forever).
+                    self._sync_peer_bg(peer)
+            elif "need" in r:
+                self._sync_peer_bg(peer)
+        now = time.monotonic()
+        if acked >= self.quorum:
+            self._last_quorum_at = now
+        elif now - self._last_quorum_at > self._cfg_s("gcs_ha_lease_ms"):
+            # A leader partitioned from every quorum must stop serving:
+            # its lease is not renewable, so a majority-side leader may
+            # already exist — step down rather than serve stale reads
+            # forever.
+            logger.warning("GCS %s lost quorum contact; stepping down",
+                           self.self_id)
+            self._become_follower()
+
+    async def commit(self, frame: bytes) -> None:
+        """Replicate one WAL frame (already stamped with the next index
+        via `stamp_record`) to a quorum. Called by the leader's
+        `flush_now` after the local append; raises QuorumLostError if a
+        majority cannot confirm — the mutation then fails upward and the
+        client retries against whoever leads next."""
+        term = self.term
+        index = self.last_index + 1
+        replies = await self._broadcast(
+            "replicate_wal", term=term, leader=self.self_id,
+            index=index, frame=frame)
+        acked = 1  # the local append already happened
+        for peer, r in replies:
+            if r is None:
+                continue
+            if r.get("term", 0) > self.term:
+                self.term = r["term"]
+                self._become_follower()
+                break
+            if r.get("ok"):
+                acked += 1
+                self.match_index[peer] = max(
+                    self.match_index.get(peer, 0), int(r.get("index", 0)))
+            elif "need" in r:
+                # Lagging or rejoined follower: install a full snapshot
+                # then retry this frame once, inline — it may be the ack
+                # that completes the quorum.
+                if await self._sync_peer(peer):
+                    retry = await self._call_peer(
+                        peer, "replicate_wal", term=term,
+                        leader=self.self_id, index=index, frame=frame)
+                    if retry is not None and retry.get("ok"):
+                        acked += 1
+                        self.match_index[peer] = index
+        if not self.is_leader() or acked < self.quorum:
+            raise QuorumLostError(
+                f"frame {index}: {acked}/{self.cluster_size} acks "
+                f"(quorum {self.quorum})")
+        self.last_index = index
+        self.last_term = term
+        self.frames_replicated += 1
+        self._last_quorum_at = time.monotonic()
+        self.server.replication_meta["state"] = {"term": term,
+                                                 "index": index}
+        from ray_tpu.core import flight
+
+        if flight.enabled:
+            flight.instant("gcs", "wal.replicate",
+                           arg=f"idx={index}:acks={acked}")
+
+    def stamp_record(self) -> tuple:
+        """The replication-meta cell embedded in every replicated frame:
+        WAL replay restores (term, index) through the ordinary record
+        path, so a rejoining replica recovers its log position for free."""
+        return ("replication_meta", "state", True,
+                {"term": self.term, "index": self.last_index + 1})
+
+    def _sync_peer_bg(self, peer: str) -> None:
+        """At most one in-flight snapshot install per peer."""
+        if peer in self._syncing:
+            return
+        self._syncing.add(peer)
+
+        async def _bg() -> None:
+            try:
+                await self._sync_peer(peer)
+            except Exception:
+                logger.debug("peer sync failed", exc_info=True)
+            finally:
+                self._syncing.discard(peer)
+
+        asyncio.ensure_future(_bg())
+
+    async def _sync_peer(self, peer: str) -> bool:
+        """Full-state catch-up: ship the persisted tables at our current
+        commit point. Small by construction (control-plane metadata)."""
+        import pickle
+
+        tables = {t: dict(getattr(self.server, t))
+                  for t in self.server._PERSISTED_TABLES}
+        blob = pickle.dumps(tables, protocol=5)
+        r = await self._call_peer(
+            peer, "install_snapshot", term=self.term, leader=self.self_id,
+            index=self.last_index, log_term=self.last_term, snapshot=blob)
+        ok = bool(r and r.get("ok"))
+        if ok:
+            self.match_index[peer] = max(
+                self.match_index.get(peer, 0), self.last_index)
+        return ok
+
+    # -- elections ----------------------------------------------------
+    async def _run_election(self) -> None:
+        self.term += 1
+        term = self.term
+        self.voted_for[term] = self.self_id
+        self.role = "candidate"
+        self.leader_id = None
+        self.elections += 1
+        self._reset_election_deadline()
+        from ray_tpu.core import flight
+
+        if flight.enabled:
+            flight.instant("gcs", "gcs.election",
+                           arg=f"{self.self_id}:term={term}")
+        logger.info("GCS %s standing for election (term %d, log %d.%d)",
+                    self.self_id, term, self.last_term, self.last_index)
+        replies = await self._broadcast(
+            "request_vote", term=term, candidate=self.self_id,
+            last_index=self.last_index, last_term=self.last_term)
+        votes = 1
+        for _peer, r in replies:
+            if r is None:
+                continue
+            if r.get("term", 0) > self.term:
+                self.term = r["term"]
+                self._become_follower()
+                return
+            if r.get("granted"):
+                votes += 1
+        if self.term != term or self.role != "candidate":
+            return  # superseded mid-election (a leader asserted itself)
+        if votes >= self.quorum:
+            await self._become_leader(term)
+        else:
+            self.role = "follower"
+            self._reset_election_deadline()
+
+    # -- follower-side handlers (dispatched via GcsServer) ------------
+    def on_request_vote(self, *, term: int, candidate: str,
+                        last_index: int, last_term: int) -> Dict[str, Any]:
+        if term > self.term:
+            self.term = term
+            self._become_follower()
+        granted = False
+        if term == self.term:
+            prior = self.voted_for.get(term)
+            # Log-completeness criterion: never elect a leader missing a
+            # quorum-acked write (the acked frame lives on a majority, so
+            # every reachable quorum contains a voter that refuses).
+            log_ok = ((last_term, last_index)
+                      >= (self.last_term, self.last_index))
+            if prior in (None, candidate) and log_ok \
+                    and self.role != "leader":
+                self.voted_for[term] = candidate
+                granted = True
+                self._reset_election_deadline()
+        return {"term": self.term, "granted": granted}
+
+    async def on_replicate(self, *, term: int, leader: str,
+                           index: int = 0,
+                           frame: Optional[bytes] = None) -> Dict[str, Any]:
+        if term < self.term:
+            return {"ok": False, "term": self.term}
+        if term > self.term or self.leader_id != leader \
+                or self.role != "follower":
+            self.term = term
+            self._become_follower(leader)
+        self.leaders_by_term.setdefault(term, leader)
+        self._reset_election_deadline()
+        if frame is None:  # lease-renewal heartbeat
+            return {"ok": True, "term": self.term, "index": self.last_index}
+        if index > self.last_index + 1:
+            return {"ok": False, "term": self.term,
+                    "need": self.last_index + 1}
+        await self._apply_frame(index, term, frame)
+        return {"ok": True, "term": self.term, "index": self.last_index}
+
+    async def _apply_frame(self, index: int, term: int,
+                           frame: bytes) -> None:
+        """Apply a replicated frame: mutate the tables (absolute cells —
+        idempotent under leader retries at the same index) and append the
+        identical frame to our own WAL, so this replica's disk recovery
+        is byte-for-byte the leader's."""
+        import pickle
+        import struct
+
+        server = self.server
+        async with server._flush_lock:
+            (n,) = struct.unpack("<I", frame[:4])
+            records = pickle.loads(frame[4:4 + n])
+            for table, key, present, value in records:
+                tbl = getattr(server, table, None)
+                if tbl is None:
+                    continue
+                if present:
+                    tbl[key] = value
+                else:
+                    tbl.pop(key, None)
+            await asyncio.to_thread(server._append_wal, frame)
+            self.last_index = max(self.last_index, index)
+            self.last_term = term
+            if server._wal_size >= ray_config().gcs_wal_compact_bytes:
+                await server._compact()
+
+    async def on_install_snapshot(self, *, term: int, leader: str,
+                                  index: int, log_term: int,
+                                  snapshot: bytes) -> Dict[str, Any]:
+        if term < self.term:
+            return {"ok": False, "term": self.term}
+        if term > self.term or self.leader_id != leader:
+            self.term = term
+            self._become_follower(leader)
+        self._reset_election_deadline()
+        import pickle
+
+        tables = pickle.loads(snapshot)
+        server = self.server
+        async with server._flush_lock:
+            for t in server._PERSISTED_TABLES:
+                tbl = getattr(server, t)
+                tbl.clear()
+                tbl.update(tables.get(t, {}))
+            self.last_index = index
+            self.last_term = log_term
+            # Persist the installed state as a compacted snapshot so a
+            # crash right after catch-up recovers to it.
+            await server._compact()
+        return {"ok": True, "term": self.term, "index": self.last_index}
+
+    # -- outbound plumbing --------------------------------------------
+    async def _broadcast(self, method: str, **kw) -> List[tuple]:
+        results = await asyncio.gather(
+            *(self._call_peer(p, method, **kw) for p in self.peers))
+        return list(zip(self.peers, results))
+
+    async def _call_peer(self, peer: str, method: str,
+                         **kw) -> Optional[Dict[str, Any]]:
+        timeout = self._cfg_s("gcs_ha_replicate_timeout_ms")
+        try:
+            return await asyncio.wait_for(
+                self._peer_call(peer, method, **kw), timeout=timeout)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return None  # dead/partitioned peer — counts as no ack
+
+    async def _dial_peer(self, peer: str, method: str, **kw) -> Any:
+        """Production outbound path: lazily-dialed RpcClients keyed by
+        replica id (the simcluster injects `peer_call` instead)."""
+        from ray_tpu.core.rpc import RpcClient
+
+        client = self._peer_clients.get(peer)
+        if client is None or not client.connected:
+            addr = self._peer_addrs[peer]
+            client = RpcClient(addr)
+            await client.connect(timeout=5.0)
+            self._peer_clients[peer] = client
+        return await client.call(method, timeout=10.0, **kw)

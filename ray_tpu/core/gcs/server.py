@@ -86,6 +86,15 @@ class GcsServer:
         from ray_tpu.core.gcs.metrics_store import MetricsStore, SloTracker
 
         self.metric_series: Dict[str, Dict[str, Any]] = {}
+        # -- HA replication (round 18) ------------------------------------
+        # When `replication` is attached (multi-replica boot), every
+        # write-through frame reaches a quorum before acking and
+        # non-leader replicas redirect mutations via NotLeaderError.
+        # `replication_meta` is an ordinary persisted table: the leader
+        # stamps (term, index) into each replicated frame so WAL replay
+        # restores a rejoining replica's log position for free.
+        self.replication = None
+        self.replication_meta: Dict[str, Any] = {}
         cfg = ray_config()
         self.metrics = MetricsStore(
             max_series=cfg.metrics_max_series,
@@ -118,6 +127,10 @@ class GcsServer:
         simulated raylets against this REAL server through in-process
         loopback dispatch."""
         self._load_storage()
+        if self.replication is not None:
+            # A rejoining replica votes with its recovered log position,
+            # never as if its log were empty.
+            self.replication.recover()
         # Re-pushed series after a restart must reuse their WAL-recovered
         # identity (no duplicate registration): seed the store with the
         # persisted metadata before the first heartbeat can arrive.
@@ -132,13 +145,19 @@ class GcsServer:
         import uuid
 
         cid = self.kv.get("__cluster_id__")
-        if cid is None:
+        if cid is not None:
+            self.cluster_id = (cid.decode() if isinstance(cid, bytes)
+                               else str(cid))
+        elif self.replication is not None and self.replication.active:
+            # Replicated boot: each replica generating its own id would
+            # fork the cluster identity. The FIRST leader mints it with a
+            # quorum-replicated write-through (_on_promoted); until then
+            # the id is pending and cluster_id queries fail-and-retry.
+            self.cluster_id = ""
+        else:
             self.cluster_id = uuid.uuid4().hex
             self.kv["__cluster_id__"] = self.cluster_id.encode()
             self.mark_dirty("kv", "__cluster_id__")
-        else:
-            self.cluster_id = (cid.decode() if isinstance(cid, bytes)
-                               else str(cid))
         if serve_rpc:
             await self._rpc.start()
         self._health_task = asyncio.ensure_future(self._health_loop())
@@ -148,12 +167,24 @@ class GcsServer:
         # Crash-resume: a kill -9 mid-reschedule leaves groups
         # RESCHEDULING (the transition was written through); a crash
         # BEFORE the transition leaves a CREATED group pointing at a
-        # node recovered as dead. Both resume here.
+        # node recovered as dead. Both resume here. (A follower replica
+        # skips this — the scan is leader work, resumed at promotion.)
         await self._rescan_reschedules()
+        if self.replication is not None:
+            self.replication.start()
         if serve_rpc:
             logger.info("GCS listening on %s", self.address)
 
     async def handle_cluster_id(self, conn: ServerConnection) -> str:
+        if not self.cluster_id:
+            # Replicated boot before the first election: the id arrives
+            # via the leader's quorum write. Pick it up if replication
+            # delivered it; otherwise the client retries on its backoff.
+            cid = self.kv.get("__cluster_id__")
+            if cid is None:
+                raise RuntimeError("cluster id pending leader election")
+            self.cluster_id = (cid.decode() if isinstance(cid, bytes)
+                               else str(cid))
         return self.cluster_id
 
     # -- durable storage (reference: gcs_table_storage.h over a store
@@ -170,7 +201,8 @@ class GcsServer:
     # reconciles the live view and clears the flag — no re-register RPC
     # needed, no herd.
     _PERSISTED_TABLES = ("nodes", "actors", "named_actors", "jobs",
-                         "placement_groups", "kv", "metric_series")
+                         "placement_groups", "kv", "metric_series",
+                         "replication_meta")
 
     def mark_dirty(self, table: Optional[str] = None,
                    *keys: str) -> None:
@@ -193,6 +225,14 @@ class GcsServer:
         actor state transitions) stay on the 1 Hz debounce."""
         if not self._storage_path:
             return
+        repl = self.replication
+        if repl is not None and repl.active and not repl.is_leader():
+            # A follower's tables mutate only through replicated frames;
+            # anything dirty here is a leftover from a previous role and
+            # must not fork the log.
+            from ray_tpu.core.gcs.replication import NotLeaderError
+
+            raise NotLeaderError(repl.leader_address(), repl.term)
         import pickle
         import struct
 
@@ -220,10 +260,22 @@ class GcsServer:
             for table, key in keys:
                 tbl = getattr(self, table)
                 records.append((table, key, key in tbl, tbl.get(key)))
+            if repl is not None and repl.active:
+                # Stamp the leader's (term, next index) into the frame:
+                # followers persist it through the ordinary record path,
+                # so every replica's WAL replay restores its log position.
+                records.append(repl.stamp_record())
             payload = pickle.dumps(records, protocol=5)
             frame = struct.pack("<I", len(payload)) + payload
             try:
                 await asyncio.to_thread(self._append_wal, frame)
+                if repl is not None and repl.active:
+                    # The leader acks a write-through only after a quorum
+                    # holds the frame — the election's log-completeness
+                    # criterion then guarantees no acked write is
+                    # forgotten across failover (PG 2PC atomicity rides
+                    # the same path).
+                    await repl.commit(frame)
                 self._flushed_gen = gen
             except Exception:
                 self._dirty_keys |= keys
@@ -442,6 +494,8 @@ class GcsServer:
         self._wal_size = 0
 
     async def stop(self) -> None:
+        if self.replication is not None:
+            self.replication.stop()
         if self._health_task:
             self._health_task.cancel()
         if self._snapshot_task:
@@ -465,6 +519,92 @@ class GcsServer:
         await self._rpc.stop()
 
     # ------------------------------------------------------------------
+    # HA replication (round 18; ray_tpu/core/gcs/replication.py)
+    # ------------------------------------------------------------------
+    # RPCs a follower replica serves locally. Everything else redirects
+    # with NotLeaderError: reads included, so clients never observe a
+    # stale follower view, and mutations included, so the replicated log
+    # has exactly one writer per term.
+    _FOLLOWER_LOCAL = frozenset((
+        "ping", "cluster_id", "cluster_info", "metrics_stats",
+        "dump_flight_record", "replicate_wal", "request_vote",
+        "install_snapshot"))
+
+    def check_dispatch(self, method: str) -> None:
+        """Admission gate invoked by ServerConnection._dispatch before
+        every handler (and therefore by the loopback sim path too)."""
+        repl = self.replication
+        if repl is None or not repl.active or repl.is_leader():
+            return
+        if method in self._FOLLOWER_LOCAL:
+            return
+        from ray_tpu.core.gcs.replication import NotLeaderError
+
+        raise NotLeaderError(repl.leader_address(), repl.term)
+
+    async def handle_replicate_wal(self, conn: ServerConnection, *,
+                                   term: int, leader: str, index: int = 0,
+                                   frame: Optional[bytes] = None
+                                   ) -> Dict[str, Any]:
+        return await self.replication.on_replicate(
+            term=term, leader=leader, index=index, frame=frame)
+
+    async def handle_request_vote(self, conn: ServerConnection, *,
+                                  term: int, candidate: str,
+                                  last_index: int, last_term: int
+                                  ) -> Dict[str, Any]:
+        return self.replication.on_request_vote(
+            term=term, candidate=candidate, last_index=last_index,
+            last_term=last_term)
+
+    async def handle_install_snapshot(self, conn: ServerConnection, *,
+                                      term: int, leader: str, index: int,
+                                      log_term: int, snapshot: bytes
+                                      ) -> Dict[str, Any]:
+        return await self.replication.on_install_snapshot(
+            term=term, leader=leader, index=index, log_term=log_term,
+            snapshot=snapshot)
+
+    async def _on_promoted(self, term: int) -> None:
+        """Election win: promotion is restart-equivalent recovery. The
+        replicated tables are already ours; the SOFT state (heartbeat
+        clocks, metric identities, SLO watchers, stuck reschedules)
+        rebuilds through the same contracts a restarted GCS uses, and
+        alive nodes get the same stale-view grace window so a failover
+        never reads as mass node death."""
+        cfg = ray_config()
+        now = time.time()
+        grace_ms = cfg.gcs_restart_node_grace_ms or (
+            cfg.health_check_period_ms
+            * cfg.health_check_failure_threshold)
+        # Followers observed no heartbeats while the election ran (those
+        # are leader-gated), so the silence clock owes the fleet the
+        # election window too — otherwise a failover reads as node death.
+        grace_ms += 2 * cfg.gcs_ha_lease_ms
+        self._restart_grace_until = now + grace_ms / 1000.0
+        for info in self.nodes.values():
+            if info.get("alive"):
+                info["stale_view"] = True
+                self._heartbeats.setdefault(info["node_id"], now)
+        self.metrics.adopt_metadata(self.metric_series)
+        self._recover_slos()
+        if not self.cluster_id:
+            # First leader of the cluster's life mints the identity with
+            # a quorum write so every replica serves the same id.
+            import uuid
+
+            self.cluster_id = uuid.uuid4().hex
+            self.kv["__cluster_id__"] = self.cluster_id.encode()
+            self.mark_dirty("kv", "__cluster_id__")
+            try:
+                await self.flush_now()
+            except Exception:
+                logger.warning("cluster id write-through failed at "
+                               "promotion; snapshot loop retries",
+                               exc_info=True)
+        await self._rescan_reschedules()
+
+    # ------------------------------------------------------------------
     # health checking (reference: gcs_health_check_manager.h:39)
     # ------------------------------------------------------------------
     async def _health_loop(self) -> None:
@@ -473,6 +613,13 @@ class GcsServer:
         threshold = cfg.health_check_failure_threshold
         while True:
             await asyncio.sleep(period)
+            if (self.replication is not None and self.replication.active
+                    and not self.replication.is_leader()):
+                # Followers see no heartbeats (those are leader-gated):
+                # a death verdict here would be judged on silence the
+                # node never owed us. Health, reschedules and SLO eval
+                # are leader work.
+                continue
             now = time.time()
             for node_id, info in list(self.nodes.items()):
                 if not info.get("alive"):
@@ -547,6 +694,9 @@ class GcsServer:
         RESCHEDULING is skipped by _mark_node_dead's CREATED-only
         trigger, so the pass can land CREATED with a location table
         naming the fresh corpse — this scan heals it."""
+        if (self.replication is not None and self.replication.active
+                and not self.replication.is_leader()):
+            return  # reschedule 2PC is leader work (resumed at promotion)
         for pg_id, pg in list(self.placement_groups.items()):
             state = pg.get("state")
             if state == "RESCHEDULING":
@@ -729,6 +879,7 @@ class GcsServer:
                                resources_available: Dict[str, float],
                                load: Optional[Dict[str, Any]] = None,
                                metrics: Optional[List[Dict[str, Any]]] = None,
+                               workers: Optional[List[Dict[str, Any]]] = None,
                                ) -> bool:
         info = self.nodes.get(node_id)
         if info is None or not info.get("alive", False):
@@ -757,6 +908,26 @@ class GcsServer:
             except Exception:
                 logger.warning("bad metrics batch from %s",
                                node_id[:8], exc_info=True)
+        if workers is not None:
+            # Batched per-worker state (ROADMAP 4d): the raylet folds its
+            # whole worker table into the node heartbeat — one RPC per
+            # tick, not one per worker — and the records land as SOFT
+            # state (not in _PERSISTED_TABLES), so worker churn never
+            # touches the quorum-replicated write path.
+            now = time.time()
+            seen = set()
+            for w in workers:
+                wid = w.get("worker_id")
+                if not wid:
+                    continue
+                seen.add(wid)
+                self.workers[wid] = dict(
+                    w, node_id=node_id, alive=True, last_seen=now)
+            for wid, info in list(self.workers.items()):
+                if info.get("node_id") == node_id and wid not in seen:
+                    # Absent from its raylet's batch: the worker exited
+                    # (the raylet reports its whole live table each tick).
+                    del self.workers[wid]
         return True
 
     async def handle_get_nodes(self, conn: ServerConnection,
@@ -1073,11 +1244,19 @@ class GcsServer:
 
     async def handle_cluster_info(self, conn: ServerConnection
                                   ) -> Dict[str, Any]:
-        return {
+        info = {
             "address": self.address,
+            "cluster_id": self.cluster_id,
             "uptime": time.time() - self._start_time,
             "num_nodes": sum(1 for n in self.nodes.values() if n["alive"]),
+            "num_workers": len(self.workers),
         }
+        if self.replication is not None:
+            # Served by followers too (_FOLLOWER_LOCAL): the dashboard
+            # and failover clients may be pointed at any replica and
+            # still learn who leads and how far replication lags.
+            info["ha"] = self.replication.status()
+        return info
 
 
 def main() -> None:
@@ -1091,6 +1270,13 @@ def main() -> None:
                         help="snapshot file for GCS fault tolerance; "
                              "restart with the same path to recover "
                              "tables")
+    parser.add_argument("--replica-id", default=None,
+                        help="this replica's id in an HA replica set "
+                             "(e.g. gcs0); requires --peers and --storage")
+    parser.add_argument("--peers", default=None,
+                        help="comma-separated id=host:port for the OTHER "
+                             "replicas (e.g. gcs1=10.0.0.2:6380,"
+                             "gcs2=10.0.0.3:6380)")
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
@@ -1106,6 +1292,17 @@ def main() -> None:
     async def run():
         server = GcsServer(args.host, args.port,
                            storage_path=args.storage)
+        if args.replica_id:
+            if not (args.peers and args.storage):
+                parser.error("--replica-id requires --peers and --storage")
+            from ray_tpu.core.gcs.replication import Replication
+
+            peer_addrs = dict(p.split("=", 1)
+                              for p in args.peers.split(",") if p)
+            peer_addrs[args.replica_id] = f"{args.host}:{args.port}"
+            server.replication = Replication(
+                server, args.replica_id, sorted(peer_addrs),
+                peer_addrs=peer_addrs)
         await server.start()
         print(f"GCS_ADDRESS={server.address}", flush=True)
         await asyncio.Event().wait()

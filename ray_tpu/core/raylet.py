@@ -652,6 +652,16 @@ class Raylet(NodeLedger):
             try:
                 metrics_batch = self._fold_metrics_batch()
                 self._metrics_hb_intervals += 1
+                # Batched worker state (ROADMAP 4d): the whole worker
+                # table rides the node heartbeat — one RPC per raylet
+                # tick, never one per worker — so at N=1000 the GCS
+                # dispatch rate stays O(nodes), not O(workers), and
+                # worker churn stays off the HA quorum write path.
+                worker_batch = [
+                    {"worker_id": w.worker_id, "state": w.state,
+                     "actor_id": w.actor_id, "lease_id": w.lease_id}
+                    for w in self._workers.values()
+                    if w.state != "dead"]
                 ok = await self._gcs.heartbeat(
                     self.node_id, self.resources_available,
                     load={"pending": len(self._pending),
@@ -660,7 +670,8 @@ class Raylet(NodeLedger):
                           # resource_load_by_shape).
                           "pending_demands": [dict(p.demand) for p in
                                               self._pending[:100]]},
-                    metrics=metrics_batch)
+                    metrics=metrics_batch,
+                    workers=worker_batch)
                 if ok is True and metrics_batch:
                     # Clear-on-ack: a failed/unrecognized heartbeat
                     # leaves the batch queued for the next interval.

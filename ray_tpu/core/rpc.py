@@ -327,6 +327,18 @@ class ServerConnection:
             await self._reply(req_id, ok=False,
                               error=f"no such method: {method}")
             return
+        gate = getattr(self._handlers, "check_dispatch", None)
+        if gate is not None:
+            # Handler-level admission gate (e.g. a GCS follower replica
+            # redirecting mutations to the leader). Raising here surfaces
+            # as the same typed error string a handler exception would,
+            # so clients need no new wire machinery to see it.
+            try:
+                gate(method)
+            except Exception as e:  # noqa: BLE001
+                await self._reply(req_id, ok=False,
+                                  error=f"{type(e).__name__}: {e}")
+                return
         if _faults_enabled():
             # Deterministic fault injection (core/faults.py): a drop rule
             # swallows the request here — the client sees a timeout /

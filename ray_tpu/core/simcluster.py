@@ -63,6 +63,11 @@ SIM_CONFIG = {
     "pg_stuck_commit_s": 2.0,
     "object_timeout_ms": 20,
     "cluster_view_refresh_ms": 100,
+    # HA GCS (round 18): compressed lease/election timings so a leader
+    # kill -9 + election + client failover cycle fits in a unit test.
+    "gcs_ha_lease_ms": 300.0,
+    "gcs_ha_renew_ms": 100.0,
+    "gcs_ha_replicate_timeout_ms": 500.0,
 }
 
 
@@ -71,7 +76,14 @@ class _SimChannel:
     `_ReconnectingRpc` semantics: a ConnectionLost call retries with the
     SAME capped-exponential-jitter backoff the real GCS client uses,
     within the same `gcs_rpc_timeout_s` window. Satisfies the interface
-    `GcsClient` needs from its rpc."""
+    `GcsClient` needs from its rpc.
+
+    HA (round 18): when the cluster boots multiple GCS replicas, a dst
+    of "gcs" re-resolves per attempt exactly like the real
+    `_ReconnectingRpc._resolve_target` — follow the NOT_LEADER hint if a
+    follower redirected us, otherwise rotate the replica set — so sim
+    raylets/drivers ride the same jittered-backoff path onto the new
+    leader that production clients do."""
 
     def __init__(self, cluster: "SimCluster", src: str, dst: str,
                  retry_window: bool = True):
@@ -79,6 +91,7 @@ class _SimChannel:
         self.src = src
         self.dst = dst
         self._retry_window = retry_window
+        self._gcs_target: Optional[str] = None  # leader hint (replica id)
         self.connected = True
 
     async def connect(self, timeout: float = 10.0) -> None:
@@ -93,16 +106,48 @@ class _SimChannel:
     def mark_subscribed(self, channel: str) -> None:
         pass
 
+    def _resolve(self, attempt: int) -> str:
+        if self.dst != "gcs":
+            return self.dst
+        ids = self._cluster.gcs_ids
+        if len(ids) == 1:
+            return ids[0]
+        if self._gcs_target is not None:
+            return self._gcs_target
+        return ids[attempt % len(ids)]
+
+    def _note_redirect(self, err: Exception) -> bool:
+        """True if `err` was a follower's NOT_LEADER redirect; records
+        the leader hint (a replica id in the sim) for the next attempt.
+        QuorumLostError is retryable too: rotate off the stuck replica."""
+        from ray_tpu.core.gcs.replication import parse_not_leader
+
+        if "QuorumLostError" in str(err):
+            self._gcs_target = None
+            return True
+        hint = parse_not_leader(str(err))
+        if hint is None:
+            return False
+        self._gcs_target = hint.get("leader")  # None = election running
+        return True
+
     async def call(self, method: str, timeout: Optional[float] = 60.0,
                    **kwargs: Any) -> Any:
+        from ray_tpu.core.rpc import RpcError
+
         try:
-            return await self._cluster.dispatch(self.src, self.dst, method,
-                                                kwargs)
+            return await self._cluster.dispatch(
+                self.src, self._resolve(0), method, kwargs)
         except ConnectionLost:
+            self._gcs_target = None
             if not self._retry_window:
                 raise
+        except RpcError as e:
+            if not self._retry_window or not self._note_redirect(e):
+                raise
         # Reconnect-retry (mirrors _ReconnectingRpc.call + _reconnect):
-        # keep trying with jittered backoff until the window closes.
+        # keep trying with jittered backoff until the window closes,
+        # re-resolving the target replica each attempt.
         loop = asyncio.get_running_loop()
         deadline = loop.time() + ray_config().gcs_rpc_timeout_s
         attempt = 0
@@ -110,10 +155,14 @@ class _SimChannel:
             await asyncio.sleep(backoff_delay(attempt))
             attempt += 1
             try:
-                return await self._cluster.dispatch(self.src, self.dst,
-                                                    method, kwargs)
+                return await self._cluster.dispatch(
+                    self.src, self._resolve(attempt), method, kwargs)
             except ConnectionLost:
+                self._gcs_target = None
                 if loop.time() >= deadline:
+                    raise
+            except RpcError as e:
+                if not self._note_redirect(e) or loop.time() >= deadline:
                     raise
 
 
@@ -718,7 +767,8 @@ class SimCluster:
                  seed: int = 0,
                  storage_path: Optional[str] = None,
                  plan: Optional[FaultPlan] = None,
-                 config: Optional[Dict[str, Any]] = None):
+                 config: Optional[Dict[str, Any]] = None,
+                 num_gcs: int = 1):
         self.num_nodes = num_nodes
         self.seed = seed
         self.node_resources = dict(resources or {"CPU": 4.0})
@@ -726,8 +776,20 @@ class SimCluster:
         self.plan = plan if plan is not None else FaultPlan(seed)
         self._config_overrides = {**SIM_CONFIG, **(config or {})}
         self._saved_config: Optional[Dict[str, Any]] = None
-        self.gcs: Optional[GcsServer] = None
-        self.gcs_epoch = 0
+        # HA (round 18): num_gcs > 1 boots a replica set ("gcs0"...)
+        # running the Raft-lite replicated WAL of gcs/replication.py.
+        # num_gcs == 1 keeps the historic single instance addressed as
+        # "gcs" — same dispatch keys, same fault-plan edges, so every
+        # pre-HA seed replays byte-identically.
+        if num_gcs > 1 and not storage_path:
+            raise ValueError("multi-replica GCS needs storage_path "
+                             "(the replicated WAL lives there)")
+        self.gcs_ids: List[str] = (
+            ["gcs"] if num_gcs == 1
+            else [f"gcs{i}" for i in range(num_gcs)])
+        self.gcs_replicas: Dict[str, Optional[GcsServer]] = {
+            rid: None for rid in self.gcs_ids}
+        self.gcs_epochs: Dict[str, int] = {rid: 0 for rid in self.gcs_ids}
         self.raylets: Dict[str, SimRaylet] = {}
         self._by_address: Dict[str, str] = {}
         # (src, dst, epoch) -> LoopbackClient bound to the live target
@@ -738,6 +800,31 @@ class SimCluster:
         # them). Borrower drivers register here too via add_driver.
         self.drivers: Dict[str, SimDriver] = {self.driver.name: self.driver}
 
+    @property
+    def gcs(self) -> Optional[GcsServer]:
+        """The serving GCS instance: the sole replica (single mode) or
+        the current leader (HA mode; None while an election runs).
+        Invariant checks and tests read tables through this, exactly as
+        before HA existed."""
+        if len(self.gcs_ids) == 1:
+            return self.gcs_replicas[self.gcs_ids[0]]
+        for g in self.gcs_replicas.values():
+            if (g is not None and g.replication is not None
+                    and g.replication.is_leader()):
+                return g
+        return None
+
+    @property
+    def gcs_epoch(self) -> int:
+        return self.gcs_epochs[self.gcs_ids[0]]
+
+    def leader_id(self) -> Optional[str]:
+        for rid, g in self.gcs_replicas.items():
+            if (g is not None and g.replication is not None
+                    and g.replication.is_leader()):
+                return rid
+        return None
+
     def add_driver(self, name: str) -> SimDriver:
         """A second owner/borrower process (e.g. the borrower of the
         data-plane acceptance scenario)."""
@@ -745,14 +832,34 @@ class SimCluster:
         self.drivers[name] = drv
         return drv
 
-    def _new_gcs(self) -> GcsServer:
+    def _storage_for(self, rid: str) -> Optional[str]:
+        if self.storage_path is None or len(self.gcs_ids) == 1:
+            return self.storage_path
+        return f"{self.storage_path}.{rid}"
+
+    def _new_gcs(self, rid: Optional[str] = None) -> GcsServer:
         """A GcsServer whose outbound raylet clients (PG reschedule 2PC)
         ride the fault-injected sim dispatch, set BEFORE start() so
         crash-resumed reschedules of recovered RESCHEDULING groups go
-        through the plan too."""
-        gcs = GcsServer(storage_path=self.storage_path)
+        through the plan too. In HA mode each replica additionally gets
+        a Replication whose peer RPCs (vote, replicate_wal, snapshot)
+        cross the SAME fault plan — elections under partitions are
+        seeded scenarios, not luck."""
+        rid = rid or self.gcs_ids[0]
+        gcs = GcsServer(storage_path=self._storage_for(rid))
         gcs.raylet_client_factory = (
-            lambda addr: _RayletCaller(self, "gcs", addr))
+            lambda addr: _RayletCaller(self, rid, addr))
+        if len(self.gcs_ids) > 1:
+            from ray_tpu.core.gcs.replication import Replication
+
+            def peer_call(peer, method, _rid=rid, **kw):
+                return self.dispatch(_rid, peer, method, kw)
+
+            gcs.replication = Replication(
+                gcs, rid, [p for p in self.gcs_ids if p != rid],
+                peer_call=peer_call,
+                address_of=lambda pid: pid,
+                rng=random.Random(f"{self.seed}:{rid}"))
         return gcs
 
     # -- lifecycle ------------------------------------------------------
@@ -761,8 +868,17 @@ class SimCluster:
         self._saved_config = dict(cfg._values)
         cfg.apply_system_config(self._config_overrides)
         self._wire_crashes()
-        self.gcs = self._new_gcs()
-        await self.gcs.start(serve_rpc=False)
+        for rid in self.gcs_ids:
+            self.gcs_replicas[rid] = self._new_gcs(rid)
+        await asyncio.gather(
+            *(g.start(serve_rpc=False)
+              for g in self.gcs_replicas.values()))
+        if len(self.gcs_ids) > 1:
+            # Let the first election settle before the raylet fleet
+            # registers: a 100-node register storm against a leaderless
+            # replica set is all redirect noise.
+            await self.wait_until(lambda: self.gcs is not None,
+                                  timeout=15.0)
         for i in range(self.num_nodes):
             node_id = f"simnode{i:04d}"
             raylet = SimRaylet(self, node_id, self.node_resources)
@@ -780,9 +896,10 @@ class SimCluster:
                 r._hb_task.cancel()
         await asyncio.gather(*(r.stop() for r in self.raylets.values()),
                              return_exceptions=True)
-        if self.gcs is not None:
-            await self.gcs.stop()
-            self.gcs = None
+        for rid, g in self.gcs_replicas.items():
+            if g is not None:
+                await g.stop()
+                self.gcs_replicas[rid] = None
         if self._saved_config is not None:
             cfg = ray_config()
             cfg._values.clear()
@@ -797,8 +914,8 @@ class SimCluster:
                 rule.on_crash = self.crash_target
 
     def crash_target(self, dst: str) -> None:
-        if dst == "gcs":
-            self.kill_gcs()
+        if dst == "gcs" or dst in self.gcs_replicas:
+            self.kill_gcs(dst if dst in self.gcs_replicas else None)
         elif dst in self.raylets:
             self.crash_raylet(dst)
 
@@ -807,8 +924,8 @@ class SimCluster:
         return self._by_address.get(address)
 
     def is_alive(self, dst: str) -> bool:
-        if dst == "gcs":
-            return self.gcs is not None
+        if dst in self.gcs_replicas:
+            return self.gcs_replicas[dst] is not None
         r = self.raylets.get(dst)
         if r is not None:
             return r.alive
@@ -816,8 +933,8 @@ class SimCluster:
         return d is not None and d.alive
 
     def _target(self, dst: str) -> Optional[Any]:
-        if dst == "gcs":
-            return self.gcs
+        if dst in self.gcs_replicas:
+            return self.gcs_replicas[dst]
         r = self.raylets.get(dst)
         if r is not None:
             return r if r.alive else None
@@ -826,7 +943,7 @@ class SimCluster:
 
     async def _client(self, src: str, dst: str,
                       target: Any) -> LoopbackClient:
-        key = (src, dst, self.gcs_epoch if dst == "gcs" else 0)
+        key = (src, dst, self.gcs_epochs.get(dst, 0))
         client = self._conns.get(key)
         if client is None or client.handlers is not target:
             client = LoopbackClient(target)
@@ -848,7 +965,7 @@ class SimCluster:
         target = self._target(dst)
         if target is None:
             raise ConnectionLost(f"sim target {dst} is down")
-        epoch = self.gcs_epoch
+        epoch = self.gcs_epochs.get(dst)
         client = await self._client(src, dst, target)
         if duplicate:
             async def _dup():
@@ -859,42 +976,64 @@ class SimCluster:
 
             asyncio.ensure_future(_dup())
         result = await client.call(method, **kwargs)
-        if dst == "gcs":
-            if self.gcs_epoch != epoch:
-                raise ConnectionLost("gcs died before replying")
+        if epoch is not None:
+            if self.gcs_epochs[dst] != epoch:
+                raise ConnectionLost(f"{dst} died before replying")
         elif not self.is_alive(dst):
             raise ConnectionLost(f"sim target {dst} died before replying")
         return result
 
     # -- chaos controls -------------------------------------------------
-    def kill_gcs(self) -> None:
+    def kill_gcs(self, replica_id: Optional[str] = None) -> None:
         """kill -9: no final flush, loops die mid-flight; only
         WAL-acked state survives to the next epoch. In-flight handler
         coroutines of the killed instance cannot be preempted in-process
         — so their replies are discarded by the epoch check in
         dispatch(), and storage is severed HERE so a zombie flush can't
-        append to the WAL the next epoch replays."""
-        if self.gcs is None:
+        append to the WAL the next epoch replays. In HA mode the
+        default victim is the current leader."""
+        rid = replica_id or (self.gcs_ids[0] if len(self.gcs_ids) == 1
+                             else self.leader_id())
+        if rid is None:
             return
-        if self.gcs._health_task is not None:
-            self.gcs._health_task.cancel()
-        if self.gcs._snapshot_task is not None:
-            self.gcs._snapshot_task.cancel()
-        for task in self.gcs._reschedule_tasks.values():
+        gcs = self.gcs_replicas.get(rid)
+        if gcs is None:
+            return
+        if gcs.replication is not None:
+            # The ticker dies with the process: a zombie leader must not
+            # keep renewing the lease it no longer holds.
+            gcs.replication.stop()
+        if gcs._health_task is not None:
+            gcs._health_task.cancel()
+        if gcs._snapshot_task is not None:
+            gcs._snapshot_task.cancel()
+        for task in gcs._reschedule_tasks.values():
             # Reschedule passes die with the process; the restarted
             # instance resumes them from the written-through
             # RESCHEDULING records.
             task.cancel()
-        self.gcs._reschedule_tasks.clear()
-        self.gcs._storage_path = None
-        self.gcs = None
-        self.gcs_epoch += 1
+        gcs._reschedule_tasks.clear()
+        gcs._storage_path = None
+        self.gcs_replicas[rid] = None
+        self.gcs_epochs[rid] += 1
 
-    async def restart_gcs(self) -> None:
+    def kill_leader(self) -> Optional[str]:
+        """kill -9 the replica currently holding the lease. Returns its
+        id (restart it later with restart_gcs(rid)) or None if no
+        leader is up."""
+        rid = self.leader_id() if len(self.gcs_ids) > 1 \
+            else self.gcs_ids[0]
+        if rid is None or self.gcs_replicas.get(rid) is None:
+            return None
+        self.kill_gcs(rid)
+        return rid
+
+    async def restart_gcs(self, replica_id: Optional[str] = None) -> None:
         assert self.storage_path, "restart needs persistent storage"
-        self.gcs = self._new_gcs()
-        await self.gcs.start(serve_rpc=False)
-        self.gcs_epoch += 1
+        rid = replica_id or self.gcs_ids[0]
+        self.gcs_replicas[rid] = self._new_gcs(rid)
+        await self.gcs_replicas[rid].start(serve_rpc=False)
+        self.gcs_epochs[rid] += 1
 
     def crash_raylet(self, node_id: str) -> None:
         raylet = self.raylets.get(node_id)

@@ -14,6 +14,10 @@ Endpoints:
   GET /api/placement_groups    GCS PG table
   GET /api/objects             per-node object-store inventories
   GET /api/cluster_status      resource totals/availability summary
+  GET /api/cluster             the summary + control-plane identity:
+                               cluster id, worker count, and on an HA
+                               deployment the leader replica, term and
+                               replication lag (round 18)
   GET /api/tasks?job_id=...    task events
   GET /api/serve               per-deployment QPS/latency/queue state
   GET /api/train               per-trial step-time telemetry
@@ -63,6 +67,7 @@ _INDEX_HTML = """<!doctype html>
 <li><a href=/api/placement_groups>placement groups</a>
 <li><a href=/api/objects>objects</a>
 <li><a href=/api/cluster_status>cluster status</a>
+<li><a href=/api/cluster>cluster (control-plane identity + HA leader)</a>
 <li><a href=/api/serve>serve deployments</a>
 <li><a href=/api/train>train telemetry</a>
 <li><a href=/api/train/profile>train profiler traces</a>
@@ -178,6 +183,8 @@ class DashboardHead:
             return await self._per_node("object_store_stats")
         if endpoint == "cluster_status":
             return await self._cluster_status()
+        if endpoint == "cluster":
+            return await self._cluster()
         if endpoint == "tasks":
             job = query.get("job_id", [None])[0]
             return await self._gcs.get_task_events(job_id=job)
@@ -279,6 +286,20 @@ class DashboardHead:
         return {"nodes_alive": alive, "nodes_total": len(nodes),
                 "resources_total": totals,
                 "resources_available": available}
+
+    async def _cluster(self) -> Dict[str, Any]:
+        """`/api/cluster`: the resource summary merged with the control
+        plane's own identity/health (`cluster_info`) — worker count and,
+        on an HA deployment, which replica leads, the current term, and
+        the replication lag (round 18). `cluster_info` is served by
+        follower replicas too, so this endpoint answers even while an
+        election runs."""
+        out = await self._cluster_status()
+        try:
+            out.update(await self._gcs.cluster_info())
+        except Exception as exc:  # noqa: BLE001
+            out["cluster_info_error"] = str(exc)
+        return out
 
     # -- workload views (tentpole: aggregate the live serve_*/train_*
     # series every node pushes into per-deployment / per-trial JSON the

@@ -716,6 +716,98 @@ def run_simcluster_bench(n_nodes: int = 100,
         return asyncio.run(bench(os.path.join(td, "gcs.pkl")))
 
 
+def run_ha_bench(scale: float = 1.0, n_nodes: int = 0) -> Dict[str, Any]:
+    """HA control plane (ISSUE 18): quorum write-through throughput and
+    client-observed failover latency on a 3-replica GCS.
+
+    `ha_failover_ms` is the number that matters: wall time from kill -9
+    of the LEADER (mid task burst) to the first quorum-ACKED write on
+    whoever wins the election — election + promotion recovery + client
+    redirect, measured where a user feels it. Several failover rounds
+    run and the best is reported (fold-best: scheduling noise only ever
+    inflates). The merged per-term leader map rides along so the guard
+    can assert election SAFETY (exactly one leader per term) on every
+    run, not just speed."""
+    import asyncio
+    import os
+    import tempfile
+
+    from ray_tpu.core.simcluster import SimCluster
+
+    n_nodes = n_nodes or max(20, int(100 * scale))
+    n_writes = max(30, int(200 * scale))
+    failover_rounds = 2
+
+    async def bench(storage_path: str) -> Dict[str, Any]:
+        cluster = SimCluster(num_nodes=n_nodes, num_gcs=3, seed=0,
+                             storage_path=storage_path)
+        await cluster.start()
+        try:
+            assert await cluster.wait_until(
+                lambda: cluster.gcs is not None
+                and cluster.registered_count() == n_nodes, timeout=60)
+
+            # Replicated write-through throughput: every put is a WAL
+            # append + quorum commit before the ack.
+            payload = os.urandom(512)
+            t0 = time.perf_counter()
+            for i in range(n_writes):
+                await cluster.driver._gcs.kv_put(
+                    f"ha/bench/{i}".encode(), payload)
+            write_dt = time.perf_counter() - t0
+
+            fo_ms = []
+            for _ in range(failover_rounds):
+                burst = asyncio.ensure_future(asyncio.gather(
+                    *(cluster.driver.submit_task(hold_s=0.002)
+                      for _ in range(50))))
+                await asyncio.sleep(0.05)  # land the kill mid-burst
+                t0 = time.perf_counter()
+                killed = cluster.kill_leader()
+                assert killed is not None
+                await cluster.driver._gcs.kv_put(b"ha/failover", payload)
+                fo_ms.append((time.perf_counter() - t0) * 1e3)
+                results = await burst
+                assert all(results), "task lost across failover"
+                await cluster.restart_gcs(killed)
+                assert await cluster.wait_until(
+                    lambda: cluster.gcs is not None and all(
+                        g is not None
+                        for g in cluster.gcs_replicas.values()),
+                    timeout=30)
+                await asyncio.sleep(0.3)  # rejoined replica catches up
+
+            # Election-safety observables, merged across replicas.
+            leaders_by_term: Dict[str, str] = {}
+            split_brain = 0
+            elections = 0
+            for g in cluster.gcs_replicas.values():
+                if g is None or g.replication is None:
+                    continue
+                elections += g.replication.elections
+                for term, ldr in g.replication.leaders_by_term.items():
+                    if leaders_by_term.setdefault(str(term), ldr) != ldr:
+                        split_brain += 1
+            status = cluster.gcs.replication.status()
+            return {
+                "sim_nodes": n_nodes,
+                "ha_replicas": 3,
+                "ha_failover_ms": round(min(fo_ms), 1),
+                "ha_failover_rounds_ms": [round(x, 1) for x in fo_ms],
+                "ha_write_through_per_s": round(n_writes / write_dt, 1),
+                "ha_elections": elections,
+                "ha_replication_lag": status["replication_lag"],
+                "ha_term": status["term"],
+                "ha_leaders_by_term": leaders_by_term,
+                "ha_split_brain_terms": split_brain,
+            }
+        finally:
+            await cluster.stop()
+
+    with tempfile.TemporaryDirectory() as td:
+        return asyncio.run(bench(os.path.join(td, "gcs.pkl")))
+
+
 def run_llm_serve_bench(scale: float = 1.0) -> Dict[str, Any]:
     """LLM-serving scenario: the continuous-batching engine vs the
     `@serve.batch`-style static policy on the SAME mixed-length
@@ -947,9 +1039,18 @@ def main() -> None:
                         "against a real GcsServer; no cluster processes")
     p.add_argument("--sim-nodes", type=int, default=100,
                    help="node count for --simcluster (default 100)")
+    p.add_argument("--ha", action="store_true",
+                   help="run ONLY the HA control-plane bench: quorum "
+                        "write-through throughput and leader kill -9 -> "
+                        "first-acked-write failover latency on a "
+                        "3-replica GCS, plus the merged one-leader-per-"
+                        "term safety observables; no cluster processes")
     args = p.parse_args()
     import ray_tpu
 
+    if args.ha:
+        print(json.dumps(run_ha_bench(scale=args.scale)))
+        return
     if args.simcluster:
         print(json.dumps(run_simcluster_bench(n_nodes=args.sim_nodes,
                                               scale=args.scale)))

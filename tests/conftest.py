@@ -203,5 +203,11 @@ def pytest_collection_modifyitems(config, items):
             perf_items.append(it)
         else:
             rest.append(it)
+    # HA consensus scenarios (`ha` mark) are the heaviest unit tests
+    # (multi-replica elections under fault schedules): run them as the
+    # TAIL of the unit lane so a broken core protocol still fails in the
+    # first seconds of the run. The 1000-node election storm additionally
+    # carries `slow` and only runs in the nightly `-m slow` tier.
+    unit_items.sort(key=lambda it: bool(it.get_closest_marker("ha")))
     if unit_items or perf_items:
         items[:] = unit_items + rest + perf_items

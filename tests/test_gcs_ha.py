@@ -1,0 +1,448 @@
+"""HA GCS — replicated control plane, leader election, client failover.
+
+ISSUE 18 acceptance: a 3-replica GCS survives kill -9 of the LEADER
+mid-placement-group-2PC and mid-task-burst at 100 nodes — a follower
+wins the election within the lease window, every in-flight task
+completes against the new leader, no placement-group reservation leaks,
+no acked write is forgotten, and the same seed replays the identical
+fault schedule.
+
+Everything runs the real `GcsServer` + `ray_tpu/core/gcs/replication.py`
+consensus code over the simcluster's fault-injected loopback dispatch
+(`core/simcluster.py` with `num_gcs=3`).
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+pytestmark = [pytest.mark.unit, pytest.mark.ha]
+
+
+def _run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def merged_leaders_by_term(cluster):
+    """The one-leader-per-term safety invariant, checked across every
+    live replica's observations. Returns {term: leader} or raises."""
+    merged = {}
+    for rid, g in cluster.gcs_replicas.items():
+        if g is None or g.replication is None:
+            continue
+        for term, leader in g.replication.leaders_by_term.items():
+            prior = merged.setdefault(term, leader)
+            assert prior == leader, (
+                f"SPLIT BRAIN: term {term} has leaders {prior} and "
+                f"{leader} (observed at {rid})")
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# wire format + vote rule units
+# ---------------------------------------------------------------------------
+
+def test_not_leader_error_roundtrips_through_error_string():
+    from ray_tpu.core.gcs.replication import (NotLeaderError,
+                                              parse_not_leader)
+
+    e = NotLeaderError("10.0.0.2:6379", 7)
+    # Crosses the wire as the standard handler-error rendering.
+    wire = f"{type(e).__name__}: {e}"
+    hint = parse_not_leader(wire)
+    assert hint == {"leader": "10.0.0.2:6379", "term": 7}
+    # Vacant leadership (election running) renders as "?" -> leader None.
+    hint = parse_not_leader("NotLeaderError: leader=? term=3")
+    assert hint == {"leader": None, "term": 3}
+    assert parse_not_leader("ValueError: nope") is None
+    assert parse_not_leader(None) is None
+
+
+def test_vote_rule_log_completeness_and_one_vote_per_term():
+    """A voter never elects a candidate whose log misses an acked write,
+    and grants at most one vote per term."""
+    from ray_tpu.core.gcs.replication import Replication
+
+    class _Srv:
+        replication_meta = {}
+
+    r = Replication(_Srv(), "gcs0", ["gcs1", "gcs2"])
+    r.term = 3
+    r.last_term, r.last_index = 3, 10
+
+    # Stale log (lower index at same term): refused.
+    v = r.on_request_vote(term=4, candidate="gcs1", last_index=9,
+                          last_term=3)
+    assert not v["granted"]
+    # Complete log: granted.
+    v = r.on_request_vote(term=4, candidate="gcs2", last_index=10,
+                          last_term=3)
+    assert v["granted"]
+    # Second candidate in the SAME term: refused (vote already cast)...
+    v = r.on_request_vote(term=4, candidate="gcs1", last_index=99,
+                          last_term=4)
+    assert not v["granted"]
+    # ...but re-granted idempotently to the same candidate (retries).
+    v = r.on_request_vote(term=4, candidate="gcs2", last_index=10,
+                          last_term=3)
+    assert v["granted"]
+    # Higher last_term beats higher index (Raft log-comparison order).
+    r.voted_for.clear()
+    v = r.on_request_vote(term=5, candidate="gcs1", last_index=1,
+                          last_term=4)
+    assert v["granted"]
+
+
+# ---------------------------------------------------------------------------
+# heartbeat worker batching (ROADMAP 4d satellite)
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_batches_worker_table_as_soft_state():
+    """The raylet folds its whole worker table into the node heartbeat:
+    one RPC per tick, records land as soft state (never on the
+    replicated write path), absent workers age out with the next batch."""
+    from ray_tpu.core.gcs.server import GcsServer
+    from ray_tpu.core.rpc_testing import LoopbackClient
+
+    async def scenario():
+        gcs = GcsServer()
+        await gcs.start(serve_rpc=False)
+        try:
+            c = LoopbackClient(gcs)
+            await c.connect()
+            await c.call("register_node", node_id="n1", address="a1",
+                         object_store_address="a1",
+                         resources={"CPU": 2.0}, labels={}, is_head=False)
+            await c.call(
+                "heartbeat", node_id="n1",
+                resources_available={"CPU": 1.0},
+                workers=[
+                    {"worker_id": "w1", "state": "idle",
+                     "actor_id": None, "lease_id": None},
+                    {"worker_id": "w2", "state": "leased",
+                     "actor_id": None, "lease_id": "L1"},
+                ])
+            assert set(gcs.workers) == {"w1", "w2"}
+            assert gcs.workers["w2"]["lease_id"] == "L1"
+            assert gcs.workers["w1"]["node_id"] == "n1"
+            info = await c.call("cluster_info")
+            assert info["num_workers"] == 2
+            # Soft state: worker churn must NOT ride the durable tables.
+            assert "workers" not in GcsServer._PERSISTED_TABLES
+            # Next batch omits w1 (it exited): the record ages out.
+            await c.call("heartbeat", node_id="n1",
+                         resources_available={"CPU": 1.0},
+                         workers=[{"worker_id": "w2", "state": "idle",
+                                   "actor_id": None, "lease_id": None}])
+            assert set(gcs.workers) == {"w2"}
+            # A heartbeat WITHOUT a batch leaves the table untouched.
+            await c.call("heartbeat", node_id="n1",
+                         resources_available={"CPU": 1.0})
+            assert set(gcs.workers) == {"w2"}
+        finally:
+            await gcs.stop()
+
+    _run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# failover mechanics on a small replica set
+# ---------------------------------------------------------------------------
+
+def test_failover_elects_follower_and_preserves_acked_writes(tmp_path):
+    """kill -9 the leader: a follower wins within the lease window, the
+    killed leader's acked writes are visible on the new leader, clients
+    ride the NOT_LEADER redirect onto it, and the restarted replica
+    rejoins as a follower and catches up to the leader's log."""
+    from ray_tpu.core.simcluster import SimCluster
+
+    async def scenario():
+        from ray_tpu.core.config import ray_config
+
+        cluster = SimCluster(
+            num_nodes=5, num_gcs=3, seed=42,
+            storage_path=os.path.join(tmp_path, "gcs.wal"))
+        await cluster.start()
+        try:
+            first = cluster.leader_id()
+            assert first is not None
+            # An acked write-through on the first leader...
+            await cluster.driver._gcs.kv_put("k", b"v1")
+            pg_id, state = await cluster.driver.create_placement_group(
+                [{"CPU": 1.0}])
+            assert state == "CREATED"
+
+            killed = cluster.kill_leader()
+            assert killed == first
+            t0 = time.monotonic()
+            lease_s = ray_config().gcs_ha_lease_ms / 1000.0
+            assert await cluster.wait_until(
+                lambda: cluster.gcs is not None, timeout=30)
+            failover_s = time.monotonic() - t0
+            # Election timeout is lease*(1+rand) <= 2*lease; give the
+            # vote round + promotion recovery generous headroom while
+            # still asserting the window is lease-scaled, not unbounded.
+            assert failover_s < 20 * lease_s, failover_s
+
+            second = cluster.leader_id()
+            assert second != killed
+            # No acked write forgotten: both mutations visible on the
+            # new leader through the ordinary client path (which itself
+            # exercises the redirect-following failover machinery).
+            assert await cluster.driver._gcs.kv_get("k") == b"v1"
+            info = await cluster.driver._gcs.get_placement_group(pg_id)
+            assert info["state"] == "CREATED"
+            # Post-failover mutations replicate on the new leader.
+            await cluster.driver._gcs.kv_put("k", b"v2")
+            assert await cluster.driver._gcs.kv_get("k") == b"v2"
+
+            # The killed replica rejoins as a FOLLOWER and catches up.
+            await cluster.restart_gcs(killed)
+            rejoined = cluster.gcs_replicas[killed]
+            assert await cluster.wait_until(
+                lambda: (not rejoined.replication.is_leader()
+                         and rejoined.replication.last_index
+                         == cluster.gcs.replication.last_index
+                         and rejoined.kv.get("k") == b"v2"),
+                timeout=15)
+            assert cluster.leader_id() == second
+            merged_leaders_by_term(cluster)
+        finally:
+            await cluster.stop()
+
+    _run(scenario())
+
+
+def test_minority_partitioned_replica_cannot_win_or_serve(tmp_path):
+    """Two-way isolate one follower: it keeps standing for election but
+    can never assemble a quorum, the majority-side leader keeps serving
+    writes, and after healing the minority replica rejoins the current
+    term as a follower (split-brain never happens)."""
+    from ray_tpu.core.faults import FaultPlan
+    from ray_tpu.core.simcluster import SimCluster
+
+    async def scenario():
+        plan = FaultPlan(seed=5)
+        cluster = SimCluster(
+            num_nodes=4, num_gcs=3, seed=5, plan=plan,
+            storage_path=os.path.join(tmp_path, "gcs.wal"))
+        await cluster.start()
+        try:
+            leader = cluster.leader_id()
+            minority = next(r for r in cluster.gcs_ids if r != leader)
+            rules = plan.isolate(minority)
+            iso_srv = cluster.gcs_replicas[minority]
+            iso = iso_srv.replication
+            elections_before = iso.elections
+
+            # Ride out several lease windows: the isolated replica's
+            # election deadline fires, it stands, nobody answers.
+            await cluster.wait_until(
+                lambda: iso.elections > elections_before, timeout=15)
+            await asyncio.sleep(1.0)
+            assert not iso.is_leader()
+            assert cluster.leader_id() == leader
+            # The majority side keeps committing (quorum of 2).
+            await cluster.driver._gcs.kv_put("during", b"partition")
+            assert (await cluster.driver._gcs.kv_get("during")
+                    == b"partition")
+
+            for r in rules:
+                plan.heal(r)
+            # Healed: the minority replica adopts the leader's term and
+            # catches up. Its inflated candidate term may force one
+            # re-election round — the invariant is convergence with one
+            # leader per term, not zero churn.
+            assert await cluster.wait_until(
+                lambda: (cluster.gcs is not None
+                         and not iso.is_leader()
+                         and iso.leader_id == cluster.leader_id()
+                         and iso_srv.kv.get("during") == b"partition"),
+                timeout=30)
+            merged_leaders_by_term(cluster)
+        finally:
+            await cluster.stop()
+
+    _run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance scenario (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def _ha_acceptance_run(tmp_path, run_idx):
+    """100 nodes, 3 GCS replicas, seeded 1% drops; kill -9 the LEADER
+    while 300 tasks and 6 placement-group 2PCs are in flight; restart it
+    mid-run so the set is back to 3/3. Returns the observables a seed
+    replay must reproduce."""
+    from ray_tpu.core.faults import FaultPlan
+    from ray_tpu.core.simcluster import SimCluster
+
+    SEED = 1918
+    N = 100
+
+    async def scenario():
+        path = os.path.join(tmp_path, f"ha-{run_idx}.pkl")
+        plan = FaultPlan(seed=SEED)
+        plan.drop(p=0.01)
+        cluster = SimCluster(num_nodes=N, num_gcs=3, seed=SEED,
+                             storage_path=path, plan=plan)
+        await cluster.start()
+        try:
+            assert await cluster.wait_until(
+                lambda: cluster.gcs is not None
+                and cluster.registered_count() == N, timeout=30)
+            await asyncio.sleep(1.2)  # persist the membership table
+
+            async def tasks():
+                return await asyncio.gather(
+                    *(cluster.driver.submit_task(hold_s=0.005)
+                      for _ in range(300)))
+
+            async def pgs():
+                out = []
+                for _ in range(6):
+                    out.append(await cluster.driver
+                               .create_placement_group([{"CPU": 1.0}] * 4))
+                return out
+
+            t_work = asyncio.ensure_future(tasks())
+            t_pgs = asyncio.ensure_future(pgs())
+            await asyncio.sleep(0.3)
+            # Mid-task-burst AND mid-PG-2PC: kill -9 the leader.
+            killed = cluster.kill_leader()
+            assert killed is not None
+            t0 = time.monotonic()
+            assert await cluster.wait_until(
+                lambda: cluster.gcs is not None, timeout=30)
+            failover_s = time.monotonic() - t0
+            new_leader = cluster.leader_id()
+            assert new_leader != killed
+            # The dead replica rejoins as a follower mid-run.
+            await asyncio.sleep(0.3)
+            await cluster.restart_gcs(killed)
+
+            results = await t_work
+            created = await t_pgs
+            # ZERO lost tasks across the failover.
+            assert all(results), f"{results.count(False)} tasks lost"
+            assert not cluster.driver.lost
+            assert len(cluster.driver.completed) == 300
+            # Acked writes survived: every PG the 2PC acked is visible
+            # on the new leader in a terminal state.
+            for pg_id, state in created:
+                assert state in ("CREATED", "INFEASIBLE"), state
+                info = cluster.gcs.placement_groups.get(pg_id)
+                assert info is not None, f"{pg_id} forgotten by failover"
+                await cluster.driver.remove_placement_group(pg_id)
+            # ZERO leaked reservations cluster-wide.
+            assert await cluster.wait_until(
+                lambda: not cluster.leaked_reservations()
+                and not cluster.resource_violations(), timeout=20), (
+                cluster.leaked_reservations(),
+                cluster.resource_violations())
+            # Election safety: exactly one leader per term, across every
+            # replica's observations.
+            leaders = merged_leaders_by_term(cluster)
+            assert leaders, "no election was ever observed"
+            # The replayable schedule: pure per-edge previews.
+            schedule = plan.preview("driver", "simnode0001",
+                                    "request_sim_lease", 200)
+            return (len(cluster.driver.completed), killed, new_leader,
+                    failover_s, [x.key() for x in schedule])
+        finally:
+            await cluster.stop()
+
+    return _run(scenario(), timeout=240)
+
+
+def test_acceptance_ha_leader_kill_mid_2pc_and_task_burst(tmp_path):
+    completed_a, killed_a, leader_a, _f, schedule_a = _ha_acceptance_run(
+        tmp_path, 0)
+    assert completed_a == 300
+    assert killed_a != leader_a
+    # Same seed -> same fault schedule, same outcome. (WHICH replica
+    # wins an election is asyncio-timing-dependent, like task placement
+    # in the base acceptance test; the replayable contract covers the
+    # fault schedule and the workload observables.)
+    completed_b, killed_b, leader_b, _f, schedule_b = _ha_acceptance_run(
+        tmp_path, 1)
+    assert completed_b == 300
+    assert schedule_a == schedule_b
+
+
+# ---------------------------------------------------------------------------
+# 1000-node election storm (nightly tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_election_storm_1000_nodes_three_replicas(tmp_path):
+    """Scale tier: 1000 nodes on a 3-replica control plane, then an
+    election storm — repeated leader kills and a minority partition while
+    the fleet heartbeats. The set must converge to one leader per term
+    every time, with the full fleet still registered at the end."""
+    from ray_tpu.core.faults import FaultPlan
+    from ray_tpu.core.simcluster import SimCluster
+
+    N = 1000
+
+    async def scenario():
+        plan = FaultPlan(seed=77)
+        cluster = SimCluster(
+            num_nodes=N, num_gcs=3, seed=77,
+            storage_path=os.path.join(tmp_path, "storm.pkl"),
+            plan=plan,
+            config={
+                # Scaled like the 1000-node registration test: relaxed
+                # liveness so the storm is elections, not node churn —
+                # and a wider lease, because a 1000-heartbeat event loop
+                # adds scheduling latency the 300ms sim lease reads as
+                # leader silence (spurious elections, quorum misses).
+                "raylet_heartbeat_period_ms": 1000,
+                "cluster_view_refresh_ms": 10000,
+                "health_check_period_ms": 2000,
+                "health_check_failure_threshold": 10,
+                "gcs_ha_lease_ms": 2000.0,
+                "gcs_ha_renew_ms": 500.0,
+                "gcs_ha_replicate_timeout_ms": 2000.0,
+            })
+        await cluster.start()
+        try:
+            assert await cluster.wait_until(
+                lambda: cluster.gcs is not None
+                and cluster.registered_count() == N, timeout=120)
+
+            # Storm round 1..3: kill whoever leads, restart it, repeat.
+            for _ in range(3):
+                killed = cluster.kill_leader()
+                assert killed is not None
+                assert await cluster.wait_until(
+                    lambda: cluster.gcs is not None, timeout=60)
+                assert cluster.leader_id() != killed
+                await cluster.restart_gcs(killed)
+                assert await cluster.wait_until(
+                    lambda: all(g is not None
+                                for g in cluster.gcs_replicas.values()),
+                    timeout=30)
+
+            # Storm round 4: minority partition + heal.
+            leader = cluster.leader_id()
+            minority = next(r for r in cluster.gcs_ids if r != leader)
+            rules = plan.isolate(minority)
+            await asyncio.sleep(2.0)
+            assert cluster.leader_id() == leader
+            for r in rules:
+                plan.heal(r)
+            assert await cluster.wait_until(
+                lambda: cluster.gcs is not None, timeout=60)
+
+            merged_leaders_by_term(cluster)
+            assert await cluster.wait_until(
+                lambda: cluster.gcs is not None
+                and cluster.registered_count() == N, timeout=120)
+        finally:
+            await cluster.stop()
+
+    _run(scenario(), timeout=600)

@@ -111,6 +111,15 @@ def test_dashboard_api_and_cluster_metrics(ray_cluster, tmp_path):
     assert st["nodes_alive"] >= 1
     assert st["resources_total"].get("CPU", 0) >= 4
 
+    # /api/cluster folds the control plane's own identity in (round 18:
+    # on an HA deployment this also carries leader/term/replication lag).
+    status, body = _get(base + "/api/cluster")
+    assert status == 200
+    st = json.loads(body)
+    assert st["nodes_alive"] >= 1
+    assert st.get("cluster_id"), st
+    assert "num_workers" in st
+
     # A user metric incremented inside a task reaches /metrics via the
     # worker -> raylet push -> dashboard scrape chain.
     @ray_tpu.remote

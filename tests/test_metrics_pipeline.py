@@ -357,3 +357,120 @@ def test_slo_specs_survive_gcs_restart(tmp_path):
             await cluster.stop()
 
     _run(scenario())
+
+
+def test_adopt_metadata_idempotent_under_double_restart(tmp_path):
+    """adopt_metadata must be a no-op on keys it already holds: two
+    kill -9/recover cycles (each of which replays the same WAL-acked
+    metadata into a fresh store, the second after the first recovery
+    re-persisted it) land on exactly one series per key, and a direct
+    double adopt on a live store neither duplicates a series nor
+    resets counters/rings the store already accumulated."""
+    from ray_tpu.core.metrics_ts import series_key
+    from ray_tpu.core.simcluster import SimCluster
+
+    async def scenario():
+        cluster = SimCluster(2, seed=13,
+                             storage_path=str(tmp_path / "gcs"))
+        await cluster.start()
+        try:
+            r0 = cluster.raylets["simnode0000"]
+            await r0._gcs.heartbeat(
+                r0.node_id, r0.resources_available, load={"pending": 0},
+                metrics=[{"t": 1.0, "series": [
+                    ["twice_total", "counter", {"role": "raylet"}, 3.0,
+                     "double-restart counter"]]}])
+            key = series_key("twice_total",
+                             {"role": "raylet", "node_id": r0.node_id[:8]})
+            await cluster.gcs.flush_now()
+
+            for cycle in (1, 2):
+                cluster.kill_gcs()
+                await cluster.restart_gcs()
+                store = cluster.gcs.metrics
+                matches = [k for k, s in store.series.items()
+                           if s.meta["name"] == "twice_total"]
+                assert matches == [key], (cycle, matches)
+                assert len(store.series[key].ring) == 0
+
+            # Direct idempotence on the live store: re-adopting the same
+            # metadata (as a second WAL replay would) must not clobber
+            # the series object that has since accumulated data.
+            store = cluster.gcs.metrics
+            await r0._gcs.heartbeat(
+                r0.node_id, r0.resources_available, load={"pending": 0},
+                metrics=[{"t": 2.0, "series": [
+                    ["twice_total", "counter", {"role": "raylet"}, 4.0]]}])
+            live = store.series[key]
+            assert live.counter_total == 4.0
+            store.adopt_metadata({key: dict(live.meta)})
+            store.adopt_metadata({key: dict(live.meta)})
+            assert store.series[key] is live
+            assert store.series[key].counter_total == 4.0
+            assert len(store.series) == len(
+                {k for k in store.series})  # no aliased duplicates
+        finally:
+            await cluster.stop()
+
+    _run(scenario())
+
+
+def test_slo_reregistration_after_failover_same_series_identity(tmp_path):
+    """HA failover (ISSUE 18): an SLO spec registered on the old leader
+    is recovered by the new one, and re-registering the same spec after
+    the election is idempotent — one objective, evaluated against the
+    same recovered series identity, no duplicates on either table."""
+    from ray_tpu.core.metrics_ts import series_key
+    from ray_tpu.core.simcluster import SimCluster
+
+    async def scenario():
+        cluster = SimCluster(3, seed=17, num_gcs=3,
+                             storage_path=str(tmp_path / "gcs"))
+        await cluster.start()
+        try:
+            r0 = cluster.raylets["simnode0000"]
+            spec = {"name": "ha_errs", "objective": "error_ratio",
+                    "bad_series": "f_total", "total_series": "r_total",
+                    "max_ratio": 0.5, "window_s": 60.0}
+            await r0._gcs.register_slo(spec)
+            await r0._gcs.heartbeat(
+                r0.node_id, r0.resources_available, load={"pending": 0},
+                metrics=[{"t": 1.0, "series": [
+                    ["f_total", "counter", {}, 1.0, "failures"],
+                    ["r_total", "counter", {}, 10.0, "requests"]]}])
+            key_f = series_key("f_total", {"node_id": r0.node_id[:8]})
+            assert key_f in cluster.gcs.metrics.series
+            await cluster.gcs.flush_now()
+
+            killed = cluster.kill_leader()
+            assert killed is not None
+
+            async def wait_leader():
+                while cluster.leader_id() is None:
+                    await asyncio.sleep(0.02)
+            await asyncio.wait_for(wait_leader(), 30)
+            new = cluster.gcs
+            # Recovered on the new leader: the spec and the WAL-acked
+            # series identity it evaluates against.
+            assert "ha_errs" in new.slo.slos
+            assert key_f in new.metrics.series
+
+            # Re-registration (a client that lost its ack retries after
+            # failover) is idempotent: same single objective, and the
+            # re-pushed series lands on the recovered identity.
+            await r0._gcs.register_slo(spec)
+            assert sum(1 for n in new.slo.slos if n == "ha_errs") == 1
+            n_before = len(new.metrics.series)
+            await r0._gcs.heartbeat(
+                r0.node_id, r0.resources_available, load={"pending": 0},
+                metrics=[{"t": 2.0, "series": [
+                    ["f_total", "counter", {}, 2.0],
+                    ["r_total", "counter", {}, 10.0]]}])
+            assert len(new.metrics.series) == n_before
+            assert new.metrics.series[key_f].counter_total == 2.0
+            rows = await r0._gcs.get_slo()
+            assert [r["name"] for r in rows] == ["ha_errs"]
+        finally:
+            await cluster.stop()
+
+    _run(scenario())
