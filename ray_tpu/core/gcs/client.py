@@ -61,6 +61,13 @@ class _ReconnectingRpc:
     def __init__(self, address: str):
         self.addresses = [a.strip() for a in address.split(",")
                           if a.strip()]
+        # The configured replica set is the durable core of the rotation
+        # set; leader hints learned from redirects are kept separately
+        # and BOUNDED, so stale hints from old incarnations can't grow
+        # the set (or keep dead addresses in rotation) forever.
+        self._seed_addresses = list(self.addresses)
+        self._hint_addresses: List[str] = []
+        self._rr = 0  # rotation cursor, persistent across reconnects
         self.address = self.addresses[0]  # current target
         self._leader_hint: Optional[str] = None
         self._client = RpcClient(self.address)
@@ -76,13 +83,24 @@ class _ReconnectingRpc:
 
     async def connect(self, timeout: float = 10.0) -> None:
         self._reconnect_lock = asyncio.Lock()
+        loop = asyncio.get_running_loop()
+        # Split the caller's budget across the replica set: one dead
+        # replica eating the FULL timeout would starve the live ones and
+        # turn worst-case initial connect into N*timeout.
+        deadline = loop.time() + timeout
+        share = max(0.5, timeout / max(1, len(self.addresses)))
         last_err: Optional[Exception] = None
-        for i, addr in enumerate(self.addresses):
+        connected = False
+        for addr in self.addresses:
+            budget = min(share, deadline - loop.time())
+            if budget <= 0:
+                break
             client = RpcClient(addr)
             try:
-                await client.connect(timeout=timeout)
+                await client.connect(timeout=budget)
                 self.address = addr
                 self._client = client
+                connected = True
                 break
             except Exception as e:  # noqa: BLE001
                 last_err = e
@@ -90,8 +108,9 @@ class _ReconnectingRpc:
                     await client.close()
                 except Exception:
                     pass
-                if i == len(self.addresses) - 1:
-                    raise last_err
+        if not connected:
+            raise last_err if last_err is not None else ConnectionLost(
+                f"GCS at {','.join(self.addresses)} unreachable")
         try:
             self._cluster_id = await self._client.call("cluster_id",
                                                        timeout=10.0)
@@ -120,6 +139,15 @@ class _ReconnectingRpc:
         except RpcError as e:
             if self._closed or not self._note_redirect(e):
                 raise
+            if not self._leader_hint:
+                # No hint to follow: rotate off this replica NOW (it may
+                # be minority-partitioned yet still accepting calls) so
+                # the retry loop starts against a different one.
+                try:
+                    await self._client.close()
+                except Exception:
+                    pass
+                await self._reconnect()
             return await self._redirect_aware_call(method, kwargs)
 
     def _note_redirect(self, err: Exception) -> bool:
@@ -174,20 +202,51 @@ class _ReconnectingRpc:
                         or loop.time() >= deadline):
                     raise
                 if not self._leader_hint:
+                    # Hint-less redirect (election running) or
+                    # QuorumLostError (minority-side replica): re-calling
+                    # the SAME replica would spin on it until the window
+                    # expires even when a majority-side leader is
+                    # reachable. Rotate off it through _reconnect after
+                    # the jittered backoff.
                     await asyncio.sleep(backoff_delay(attempt))
+                    try:
+                        await self._client.close()
+                    except Exception:
+                        pass
+                    await self._reconnect()
             attempt += 1
+
+    def _note_hint_address(self, addr: str) -> None:
+        """Admit a redirect hint into the rotation set without letting
+        stale hints accumulate: the set is the configured seed replicas
+        plus at most a replica-set's worth of the newest hints."""
+        if addr in self._seed_addresses:
+            return
+        if addr in self._hint_addresses:
+            self._hint_addresses.remove(addr)
+        self._hint_addresses.append(addr)
+        keep = max(1, len(self._seed_addresses))
+        del self._hint_addresses[:-keep]
+        self.addresses = self._seed_addresses + self._hint_addresses
 
     def _resolve_target(self, attempt: int) -> str:
         """Pick the address for THIS reconnect attempt. Re-resolving
         per attempt (instead of binding at construction) is what lets a
         client follow a GCS that moved or failed over: prefer the last
-        NOT_LEADER hint, otherwise rotate the replica set."""
+        NOT_LEADER hint, otherwise rotate the replica set — skipping the
+        address we just gave up on, so a deliberate rotation (hint-less
+        redirect off a minority replica) never re-dials it first."""
         if self._leader_hint:
             hint, self._leader_hint = self._leader_hint, None
-            if hint not in self.addresses:
-                self.addresses.append(hint)
+            self._note_hint_address(hint)
             return hint
-        return self.addresses[attempt % len(self.addresses)]
+        n = len(self.addresses)
+        addr = self.addresses[self._rr % n]
+        self._rr += 1
+        if addr == self.address and n > 1:
+            addr = self.addresses[self._rr % n]
+            self._rr += 1
+        return addr
 
     async def _reconnect(self) -> None:
         from ray_tpu.core import flight
@@ -207,8 +266,15 @@ class _ReconnectingRpc:
                 try:
                     if flight.enabled:
                         flight.instant("gcs", "gcs.retry", arg=attempt)
+                    # Short per-dial budget: RpcClient.connect retries a
+                    # refused/dead address internally until its timeout,
+                    # so a generous budget here turns every dead replica
+                    # in the rotation into a multi-second sink (a 3-of-4
+                    # set with one dead node would burn most of the
+                    # reconnect window on it). THIS loop is the retry
+                    # mechanism — move on to the next replica quickly.
                     await fresh.connect(
-                        timeout=min(5.0, max(0.5,
+                        timeout=min(1.0, max(0.25,
                                              deadline - loop.time())))
                     if self._cluster_id:
                         # Ephemeral-port reuse: whoever answers on the

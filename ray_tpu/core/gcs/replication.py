@@ -145,11 +145,47 @@ class Replication:
     def recover(self) -> None:
         """Seed (term, index) from the persisted `replication_meta`
         record the WAL replay restored — a rejoining replica must not
-        vote as if its log were empty."""
+        vote as if its log were empty — and the Raft hard state
+        (currentTerm, votedFor) the `vote` record persisted: a replica
+        that granted a vote in term N and was kill -9'd must come back
+        remembering it, or it could vote twice in term N and mint two
+        leaders for one term."""
         st = self.server.replication_meta.get("state") or {}
         self.last_index = int(st.get("index", 0))
         self.last_term = int(st.get("term", 0))
-        self.term = max(self.term, self.last_term)
+        vote = self.server.replication_meta.get("vote") or {}
+        vterm = int(vote.get("term", 0))
+        if vterm and vote.get("voted_for"):
+            self.voted_for[vterm] = vote["voted_for"]
+        self.term = max(self.term, self.last_term, vterm)
+
+    async def _persist_hard_state(self) -> bool:
+        """Durably record (currentTerm, votedFor) BEFORE acting on them.
+        The `vote` record is per-replica LOCAL state: it rides our own
+        WAL (so `recover` sees it after a crash) but is never shipped in
+        replicated frames or snapshots — a leader's vote must not
+        overwrite a follower's. Returns False when the write failed; the
+        caller must then refuse to vote / stand."""
+        import pickle
+        import struct
+
+        server = self.server
+        record = {"term": self.term,
+                  "voted_for": self.voted_for.get(self.term)}
+        server.replication_meta["vote"] = record
+        if not getattr(server, "_storage_path", None):
+            return True  # storage-less replica (unit rigs): in-memory only
+        payload = pickle.dumps(
+            [("replication_meta", "vote", True, record)], protocol=5)
+        frame = struct.pack("<I", len(payload)) + payload
+        try:
+            async with server._flush_lock:
+                await asyncio.to_thread(server._append_wal, frame)
+            return True
+        except Exception:
+            logger.warning("GCS %s could not persist vote state",
+                           self.self_id, exc_info=True)
+            return False
 
     def start(self) -> None:
         if self._task is None and self.active:
@@ -261,7 +297,7 @@ class Replication:
         term = self.term
         replies = await self._broadcast(
             "replicate_wal", term=term, leader=self.self_id,
-            index=self.last_index, frame=None)
+            index=self.last_index, prev_term=self.last_term, frame=None)
         acked = 1
         for peer, r in replies:
             if r is None:
@@ -274,11 +310,20 @@ class Replication:
                 acked += 1
                 idx = int(r.get("index", 0))
                 self.match_index[peer] = max(
-                    self.match_index.get(peer, 0), idx)
-                if idx < self.last_index:
+                    self.match_index.get(peer, 0),
+                    min(idx, self.last_index))
+                rlt = r.get("log_term")
+                if idx < self.last_index or (
+                        rlt is not None
+                        and (idx, rlt) != (self.last_index,
+                                           self.last_term)):
                     # Restarted/lagging follower: catch it up from the
                     # heartbeat, not only on the next write (a quiet
                     # cluster would otherwise leave it behind forever).
+                    # A log head that MISMATCHES ours (rather than
+                    # trailing it) is a diverged tail — a crash-replayed
+                    # frame no quorum ever acked — and the snapshot
+                    # install is what rolls it back.
                     self._sync_peer_bg(peer)
             elif "need" in r:
                 self._sync_peer_bg(peer)
@@ -304,7 +349,7 @@ class Replication:
         index = self.last_index + 1
         replies = await self._broadcast(
             "replicate_wal", term=term, leader=self.self_id,
-            index=index, frame=frame)
+            index=index, prev_term=self.last_term, frame=frame)
         acked = 1  # the local append already happened
         for peer, r in replies:
             if r is None:
@@ -324,7 +369,8 @@ class Replication:
                 if await self._sync_peer(peer):
                     retry = await self._call_peer(
                         peer, "replicate_wal", term=term,
-                        leader=self.self_id, index=index, frame=frame)
+                        leader=self.self_id, index=index,
+                        prev_term=self.last_term, frame=frame)
                     if retry is not None and retry.get("ok"):
                         acked += 1
                         self.match_index[peer] = index
@@ -372,8 +418,12 @@ class Replication:
         commit point. Small by construction (control-plane metadata)."""
         import pickle
 
-        tables = {t: dict(getattr(self.server, t))
-                  for t in self.server._PERSISTED_TABLES}
+        tables = {}
+        for t in self.server._PERSISTED_TABLES:
+            tbl = dict(getattr(self.server, t))
+            if t == "replication_meta":
+                tbl.pop("vote", None)  # our vote is not the peer's vote
+            tables[t] = tbl
         blob = pickle.dumps(tables, protocol=5)
         r = await self._call_peer(
             peer, "install_snapshot", term=self.term, leader=self.self_id,
@@ -393,6 +443,12 @@ class Replication:
         self.leader_id = None
         self.elections += 1
         self._reset_election_deadline()
+        if not await self._persist_hard_state():
+            # Candidacy we can't durably record is candidacy we must not
+            # announce: a crash would forget the self-vote and free this
+            # replica to vote for someone else in the same term.
+            self.role = "follower"
+            return
         from ray_tpu.core import flight
 
         if flight.enabled:
@@ -422,8 +478,9 @@ class Replication:
             self._reset_election_deadline()
 
     # -- follower-side handlers (dispatched via GcsServer) ------------
-    def on_request_vote(self, *, term: int, candidate: str,
-                        last_index: int, last_term: int) -> Dict[str, Any]:
+    async def on_request_vote(self, *, term: int, candidate: str,
+                              last_index: int,
+                              last_term: int) -> Dict[str, Any]:
         if term > self.term:
             self.term = term
             self._become_follower()
@@ -438,12 +495,19 @@ class Replication:
             if prior in (None, candidate) and log_ok \
                     and self.role != "leader":
                 self.voted_for[term] = candidate
-                granted = True
-                self._reset_election_deadline()
+                # The vote counts only once it is durable: granting and
+                # then crashing before the fsync would let this replica
+                # re-vote in the same term after restart. The in-memory
+                # vote stays even on failure (conservative — we still
+                # refuse other candidates this incarnation).
+                if await self._persist_hard_state():
+                    granted = True
+                    self._reset_election_deadline()
         return {"term": self.term, "granted": granted}
 
     async def on_replicate(self, *, term: int, leader: str,
                            index: int = 0,
+                           prev_term: Optional[int] = None,
                            frame: Optional[bytes] = None) -> Dict[str, Any]:
         if term < self.term:
             return {"ok": False, "term": self.term}
@@ -454,10 +518,31 @@ class Replication:
         self.leaders_by_term.setdefault(term, leader)
         self._reset_election_deadline()
         if frame is None:  # lease-renewal heartbeat
-            return {"ok": True, "term": self.term, "index": self.last_index}
+            # Reply with our full log head: the leader compares it to its
+            # own and snapshots us if we trail it OR diverge from it.
+            return {"ok": True, "term": self.term,
+                    "index": self.last_index, "log_term": self.last_term}
         if index > self.last_index + 1:
             return {"ok": False, "term": self.term,
                     "need": self.last_index + 1}
+        if prev_term is not None:
+            # No-rollback only holds for frames that extend a matching
+            # log. A crash can replay an UNCOMMITTED frame (appended
+            # locally, quorum never reached) as if committed; when the
+            # next leader — elected without it — sends a conflicting
+            # frame at an overlapping index, blind application would
+            # leave the divergent cells in place forever. Detect the
+            # mismatch and demand a snapshot install (which rolls the
+            # tail back) instead of applying.
+            diverged = (
+                (index == self.last_index + 1
+                 and prev_term != self.last_term)
+                or (index <= self.last_index
+                    and (index, term) != (self.last_index,
+                                          self.last_term)))
+            if diverged:
+                return {"ok": False, "term": self.term, "need": index,
+                        "diverged": True}
         await self._apply_frame(index, term, frame)
         return {"ok": True, "term": self.term, "index": self.last_index}
 
@@ -475,6 +560,8 @@ class Replication:
             (n,) = struct.unpack("<I", frame[:4])
             records = pickle.loads(frame[4:4 + n])
             for table, key, present, value in records:
+                if table == "replication_meta" and key == "vote":
+                    continue  # per-replica hard state, never replicated
                 tbl = getattr(server, table, None)
                 if tbl is None:
                     continue
@@ -502,10 +589,19 @@ class Replication:
         tables = pickle.loads(snapshot)
         server = self.server
         async with server._flush_lock:
+            # The install may REGRESS our (term, index) — that is the
+            # rollback path for a crash-replayed uncommitted tail — but
+            # our own vote record must survive it (Raft hard state is
+            # per-replica, not part of the replicated log).
+            local_vote = server.replication_meta.get("vote")
             for t in server._PERSISTED_TABLES:
                 tbl = getattr(server, t)
                 tbl.clear()
                 tbl.update(tables.get(t, {}))
+            if local_vote is not None:
+                server.replication_meta["vote"] = local_vote
+            else:
+                server.replication_meta.pop("vote", None)
             self.last_index = index
             self.last_term = log_term
             # Persist the installed state as a compacted snapshot so a

@@ -258,6 +258,11 @@ class GcsServer:
             # thread only ever touches immutable bytes.
             records = []
             for table, key in keys:
+                if table == "replication_meta" and key == "vote":
+                    # Raft hard state is per-replica and written through
+                    # its own direct WAL path — it must never ride a
+                    # replicated frame onto a follower.
+                    continue
                 tbl = getattr(self, table)
                 records.append((table, key, key in tbl, tbl.get(key)))
             if repl is not None and repl.active:
@@ -544,16 +549,18 @@ class GcsServer:
 
     async def handle_replicate_wal(self, conn: ServerConnection, *,
                                    term: int, leader: str, index: int = 0,
+                                   prev_term: Optional[int] = None,
                                    frame: Optional[bytes] = None
                                    ) -> Dict[str, Any]:
         return await self.replication.on_replicate(
-            term=term, leader=leader, index=index, frame=frame)
+            term=term, leader=leader, index=index, prev_term=prev_term,
+            frame=frame)
 
     async def handle_request_vote(self, conn: ServerConnection, *,
                                   term: int, candidate: str,
                                   last_index: int, last_term: int
                                   ) -> Dict[str, Any]:
-        return self.replication.on_request_vote(
+        return await self.replication.on_request_vote(
             term=term, candidate=candidate, last_index=last_index,
             last_term=last_term)
 
@@ -588,6 +595,17 @@ class GcsServer:
                 self._heartbeats.setdefault(info["node_id"], now)
         self.metrics.adopt_metadata(self.metric_series)
         self._recover_slos()
+        if not self.cluster_id:
+            # A replica that never served a cluster_id RPC still has the
+            # lazy "" sentinel even when the replicated kv already holds
+            # the identity — adopt it. Minting a fresh id here would fork
+            # the cluster identity at every failover and lock out every
+            # client that cached the original (their reconnect identity
+            # check would read the new leader as a foreign cluster).
+            cid = self.kv.get("__cluster_id__")
+            if cid is not None:
+                self.cluster_id = (cid.decode() if isinstance(cid, bytes)
+                                   else str(cid))
         if not self.cluster_id:
             # First leader of the cluster's life mints the identity with
             # a quorum write so every replica serves the same id.

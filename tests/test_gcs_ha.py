@@ -68,31 +68,253 @@ def test_vote_rule_log_completeness_and_one_vote_per_term():
     class _Srv:
         replication_meta = {}
 
-    r = Replication(_Srv(), "gcs0", ["gcs1", "gcs2"])
-    r.term = 3
-    r.last_term, r.last_index = 3, 10
+    async def scenario():
+        r = Replication(_Srv(), "gcs0", ["gcs1", "gcs2"])
+        r.term = 3
+        r.last_term, r.last_index = 3, 10
 
-    # Stale log (lower index at same term): refused.
-    v = r.on_request_vote(term=4, candidate="gcs1", last_index=9,
-                          last_term=3)
-    assert not v["granted"]
-    # Complete log: granted.
-    v = r.on_request_vote(term=4, candidate="gcs2", last_index=10,
-                          last_term=3)
-    assert v["granted"]
-    # Second candidate in the SAME term: refused (vote already cast)...
-    v = r.on_request_vote(term=4, candidate="gcs1", last_index=99,
-                          last_term=4)
-    assert not v["granted"]
-    # ...but re-granted idempotently to the same candidate (retries).
-    v = r.on_request_vote(term=4, candidate="gcs2", last_index=10,
-                          last_term=3)
-    assert v["granted"]
-    # Higher last_term beats higher index (Raft log-comparison order).
-    r.voted_for.clear()
-    v = r.on_request_vote(term=5, candidate="gcs1", last_index=1,
-                          last_term=4)
-    assert v["granted"]
+        # Stale log (lower index at same term): refused.
+        v = await r.on_request_vote(term=4, candidate="gcs1",
+                                    last_index=9, last_term=3)
+        assert not v["granted"]
+        # Complete log: granted.
+        v = await r.on_request_vote(term=4, candidate="gcs2",
+                                    last_index=10, last_term=3)
+        assert v["granted"]
+        # Second candidate in the SAME term: refused (vote already
+        # cast)...
+        v = await r.on_request_vote(term=4, candidate="gcs1",
+                                    last_index=99, last_term=4)
+        assert not v["granted"]
+        # ...but re-granted idempotently to the same candidate (retries).
+        v = await r.on_request_vote(term=4, candidate="gcs2",
+                                    last_index=10, last_term=3)
+        assert v["granted"]
+        # Higher last_term beats higher index (Raft log-comparison
+        # order).
+        r.voted_for.clear()
+        v = await r.on_request_vote(term=5, candidate="gcs1",
+                                    last_index=1, last_term=4)
+        assert v["granted"]
+
+    _run(scenario())
+
+
+def test_vote_survives_kill_minus_9(tmp_path):
+    """Raft hard state: a replica that granted a vote in term N and was
+    kill -9'd must restart REMEMBERING the vote (term and votedFor are
+    fsynced before the grant) — otherwise it could vote again in term N
+    for a different candidate and mint two leaders for one term."""
+    from ray_tpu.core.gcs.replication import Replication
+    from ray_tpu.core.gcs.server import GcsServer
+
+    path = os.path.join(tmp_path, "vote.pkl")
+
+    async def scenario():
+        gcs = GcsServer(storage_path=path)
+        repl = Replication(gcs, "gcs0", ["gcs1", "gcs2"])
+        gcs.replication = repl
+        gcs._load_storage()
+        repl.recover()
+        v = await repl.on_request_vote(term=5, candidate="gcs1",
+                                       last_index=0, last_term=0)
+        assert v["granted"]
+
+        # kill -9: a NEW incarnation recovers from disk alone (no clean
+        # shutdown, no in-memory state carried over).
+        gcs2 = GcsServer(storage_path=path)
+        repl2 = Replication(gcs2, "gcs0", ["gcs1", "gcs2"])
+        gcs2.replication = repl2
+        gcs2._load_storage()
+        repl2.recover()
+        assert repl2.term == 5, "currentTerm regressed across restart"
+        # A DIFFERENT candidate in the voted term: refused, even with a
+        # longer log — the persisted vote wins.
+        v = await repl2.on_request_vote(term=5, candidate="gcs2",
+                                        last_index=99, last_term=9)
+        assert not v["granted"], "restart forgot the vote (double vote)"
+        # The original candidate's retry is still honored.
+        v = await repl2.on_request_vote(term=5, candidate="gcs1",
+                                        last_index=0, last_term=0)
+        assert v["granted"]
+
+    _run(scenario())
+
+
+def test_promotion_adopts_replicated_cluster_id(tmp_path):
+    """A follower promoted after failover carries the lazy '' cluster-id
+    sentinel (it never served a cluster_id RPC) while the replicated kv
+    already holds the identity the first leader minted. Promotion must
+    ADOPT it — minting a fresh id would fork the cluster identity at
+    every failover and lock out every client that cached the original
+    (their reconnect identity check reads the new leader as a foreign
+    cluster)."""
+    from ray_tpu.core.gcs.replication import Replication
+    from ray_tpu.core.gcs.server import GcsServer
+
+    async def scenario():
+        gcs = GcsServer(storage_path=os.path.join(tmp_path, "id.pkl"))
+        gcs.replication = Replication(gcs, "gcs1", ["gcs0", "gcs2"])
+        gcs.cluster_id = ""  # replicated boot: id pending first leader
+        gcs.kv["__cluster_id__"] = b"minted-by-first-leader"
+        await gcs._on_promoted(term=2)
+        assert gcs.cluster_id == "minted-by-first-leader", (
+            "promotion re-minted the cluster id: identity fork")
+        assert gcs.kv["__cluster_id__"] == b"minted-by-first-leader"
+
+    _run(scenario())
+
+
+def test_divergent_uncommitted_tail_demands_snapshot():
+    """No-rollback only holds for frames extending a MATCHING log. A
+    crash can replay an uncommitted frame (appended locally, quorum
+    never reached) as if committed; when a new leader elected without it
+    sends a conflicting frame at an overlapping index, the follower must
+    refuse and demand a snapshot install (the rollback path) instead of
+    silently merging divergent histories."""
+    from ray_tpu.core.gcs.replication import Replication
+
+    class _Srv:
+        replication_meta = {}
+
+    async def scenario():
+        r = Replication(_Srv(), "gcs1", ["gcs0", "gcs2"])
+        # Crash-replayed tail at (term 1, index 5); the cluster moved on
+        # without it: the term-2 leader was elected at log (1, 4).
+        r.term, r.last_term, r.last_index = 2, 1, 5
+
+        # The new leader's own frame 5: same index, different history.
+        rep = await r.on_replicate(term=2, leader="gcs0", index=5,
+                                   prev_term=1, frame=b"x")
+        assert not rep["ok"] and "need" in rep and rep.get("diverged")
+
+        # An extension whose prev_term disagrees with our tail: refused
+        # too (the leader committed ITS frame 5 in term 2 already).
+        rep = await r.on_replicate(term=2, leader="gcs0", index=6,
+                                   prev_term=2, frame=b"x")
+        assert not rep["ok"] and "need" in rep
+
+        # Heartbeats advertise the full log head (index AND term) so the
+        # leader can spot the divergence from its side and snapshot us.
+        rep = await r.on_replicate(term=2, leader="gcs0", index=4,
+                                   prev_term=1, frame=None)
+        assert rep["ok"]
+        assert rep["index"] == 5 and rep["log_term"] == 1
+
+    _run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# production client failover mechanics (fake RpcClient, no sockets)
+# ---------------------------------------------------------------------------
+
+def _fake_rpc_client(calls, behaviors):
+    """A stand-in for core.rpc.RpcClient: `behaviors[addr]` maps a
+    method call to a return value or a raised exception."""
+
+    class FakeRpcClient:
+        def __init__(self, addr):
+            self.addr = addr
+            self._connected = False
+
+        @property
+        def connected(self):
+            return self._connected
+
+        async def connect(self, timeout=None):
+            b = behaviors.get(self.addr, {})
+            if "connect" in b:
+                calls.append((self.addr, "connect", timeout))
+                raise b["connect"]
+            self._connected = True
+
+        async def close(self):
+            self._connected = False
+
+        def on_push(self, channel, handler):
+            pass
+
+        async def call(self, method, **kw):
+            if method == "cluster_id":
+                return "cid"
+            calls.append((self.addr, method))
+            out = behaviors.get(self.addr, {}).get(method)
+            if isinstance(out, Exception):
+                raise out
+            return out
+
+    return FakeRpcClient
+
+
+def test_client_rotates_off_replica_on_hintless_redirect(monkeypatch):
+    """A hint-less NOT_LEADER redirect (election running) or a
+    QuorumLostError must rotate the client onto the NEXT replica — not
+    spin on the same minority-side replica (which still accepts
+    connections) until the rpc window expires."""
+    from ray_tpu.core.gcs import client as client_mod
+    from ray_tpu.core.rpc import RpcError
+
+    calls = []
+    fake = _fake_rpc_client(calls, {
+        "a": {"ping": RpcError("NotLeaderError: leader=? term=3")},
+        "b": {"ping": "pong"},
+    })
+    monkeypatch.setattr(client_mod, "RpcClient", fake)
+
+    async def scenario():
+        rpc = client_mod._ReconnectingRpc("a,b")
+        await rpc.connect()
+        assert rpc.address == "a"
+        assert await rpc.call("ping") == "pong"
+        assert rpc.address == "b"
+        # The stuck replica was tried once, then rotated away from.
+        assert calls.count(("a", "ping")) == 1
+        assert calls.count(("b", "ping")) == 1
+
+    _run(scenario())
+
+
+def test_client_connect_splits_timeout_across_replicas(monkeypatch):
+    """Initial connect must budget the caller's timeout across the
+    replica set (a dead first replica can't eat the whole window), and
+    still land on a live replica."""
+    from ray_tpu.core.gcs import client as client_mod
+    from ray_tpu.core.rpc import ConnectionLost
+
+    calls = []
+    fake = _fake_rpc_client(calls, {
+        "a": {"connect": ConnectionLost("down")},
+    })
+    monkeypatch.setattr(client_mod, "RpcClient", fake)
+
+    async def scenario():
+        rpc = client_mod._ReconnectingRpc("a,b")
+        await rpc.connect(timeout=4.0)
+        assert rpc.address == "b"
+        # The dead replica got a SHARE of the window, not all of it.
+        (_, _, budget), = [c for c in calls if c[1] == "connect"]
+        assert budget <= 2.0
+
+    _run(scenario())
+
+
+def test_client_rotation_set_does_not_accumulate_stale_hints():
+    """Leader hints learned from redirects join the rotation set bounded
+    and deduplicated: a long-lived client chasing failovers must not
+    grow an unbounded list of dead addresses."""
+    from ray_tpu.core.gcs.client import _ReconnectingRpc
+
+    seed = ["h1:1", "h2:1", "h3:1"]
+    rpc = _ReconnectingRpc(",".join(seed))
+    for i in range(50):
+        rpc._leader_hint = f"hint{i}:9"
+        rpc._resolve_target(0)
+    assert len(rpc.addresses) <= 6  # seed (3) + bounded hints (<=3)
+    assert set(seed) <= set(rpc.addresses)
+    # Re-learning a known hint moves it to freshest, no duplicate.
+    rpc._leader_hint = "hint49:9"
+    rpc._resolve_target(0)
+    assert rpc.addresses.count("hint49:9") == 1
 
 
 # ---------------------------------------------------------------------------
