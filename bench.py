@@ -154,6 +154,19 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         notes["llm_serve_error"] = repr(e)
     try:
+        # Serving fleet (round 19): 3 replicas behind the KV-cache-
+        # aware fleet router — warm-everywhere (cross-replica prefix
+        # shipping) vs cold-per-replica tokens/s and TTFT, plus
+        # seeded-kill conversation-recovery latency with the
+        # zero-lost-conversations honesty counter.
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.perf", "--fleet"],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        notes["fleet"] = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        notes["fleet_bench_error"] = repr(e)
+    try:
         out = subprocess.run(
             [sys.executable, "-m", "ray_tpu.rllib.bench"],
             capture_output=True, text=True, timeout=300,

@@ -405,7 +405,44 @@ class DashboardHead:
             "latency_p95_s": self._hist_quantile(
                 m.get("serve_request_latency_seconds", []), 0.95),
         }
-        return {"deployments": deployments, "ingress": ingress}
+        # Per-replica radix prefix-index state (PR 19): each engine
+        # pushes serve_prefix_* gauges tagged with its replica id; the
+        # pane groups them so an operator sees which replica holds how
+        # much sealed prefix (and whether eviction is churning it).
+        prefix: Dict[str, Dict[str, Any]] = {}
+
+        def pslot(replica: str) -> Dict[str, Any]:
+            return prefix.setdefault(replica, {
+                "nodes": 0.0, "sealed_blocks": 0.0, "hits": 0.0,
+                "evictions": 0.0})
+
+        for metric, key in (("serve_prefix_index_nodes", "nodes"),
+                            ("serve_prefix_sealed_blocks",
+                             "sealed_blocks"),
+                            ("serve_prefix_hits", "hits"),
+                            ("serve_prefix_evictions", "evictions")):
+            for s in m.get(metric, []):
+                pslot(s["tags"].get("replica", "?"))[key] = s["value"]
+        out: Dict[str, Any] = {"deployments": deployments,
+                               "ingress": ingress, "prefix": prefix}
+        # Fleet control-layer totals (KV-aware routing + shipping +
+        # recovery), when a fleet is running anywhere in the cluster.
+        fleet: Dict[str, float] = {}
+        for metric, key in (
+                ("serve_fleet_prefix_ships", "prefix_ships"),
+                ("serve_fleet_prefix_ship_tokens",
+                 "prefix_ship_tokens"),
+                ("serve_fleet_conversation_recoveries", "recoveries"),
+                ("serve_fleet_route_prefix_hits", "route_prefix_hits"),
+                ("serve_fleet_route_sticky_hits", "route_sticky_hits"),
+                ("serve_fleet_replicas_alive", "replicas_alive")):
+            samples = m.get(metric, [])
+            if samples:
+                fleet[key] = sum(float(s.get("value", 0.0))
+                                 for s in samples)
+        if fleet:
+            out["fleet"] = fleet
+        return out
 
     async def _train_state(self) -> Dict[str, Any]:
         m = await self._workload_snapshot("train_")

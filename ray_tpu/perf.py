@@ -972,6 +972,140 @@ def run_llm_serve_bench(scale: float = 1.0) -> Dict[str, Any]:
     return out
 
 
+def run_fleet_bench(scale: float = 1.0) -> Dict[str, Any]:
+    """Multi-replica serving fleet: cross-replica prefix shipping and
+    conversation recovery, 3 in-process `InferenceEngine` replicas
+    behind the KV-cache-aware `ServeFleet` router.
+
+    Phase 1 — warm-everywhere vs cold-per-replica: the SAME burst of
+    shared-system-prompt conversations (80-token prompt, 5 sealed
+    16-token blocks, simulated per-token prefill cost) through the
+    identical fleet twice. Cold: KV-aware routing and shipping OFF —
+    pure least-loaded spread, each replica pays its own full system-
+    prompt prefill. Warm: routing + shipping ON after one warm-up
+    conversation on one replica — overload spill moves excess
+    conversations to cold replicas, but each spill ships the sealed
+    prompt chain first, so the spilled conversation prefills only its
+    3-token tail. The ratio measures the fleet layer itself (the
+    per-replica engines are identical, local prefix sharing on in both).
+
+    Phase 2 — recovery: a seeded `crash_after` kills a replica on its
+    nth streamed token mid-decode; the fleet migrates the conversation
+    to a survivor which re-prefills through its radix index and
+    continues. Recovery latency = kill -> first post-recovery token;
+    the output is asserted token-for-token against the no-fault oracle.
+
+    Returns:
+      fleet_warm_tok_s / fleet_cold_tok_s / fleet_warm_vs_cold
+      fleet_cold_ttft_p50_ms      : TTFT when every replica re-prefills
+      fleet_remote_warm_ttft_p50_ms : TTFT of conversations whose
+        prefix was shipped in (must beat cold re-prefill)
+      fleet_ttft_cold_over_remote : the shipping TTFT win
+      fleet_prefix_ships / fleet_prefix_ship_tokens
+      fleet_recovery_ms           : replica kill -> first survivor token
+      fleet_recoveries / fleet_lost_conversations
+    """
+    from ray_tpu.core.faults import FaultPlan
+    from ray_tpu.serve.engine import EngineConfig, TinyLM
+    from ray_tpu.serve.fleet import FleetConfig, ServeFleet
+
+    out: Dict[str, Any] = {}
+    sys_prompt = [7 + (i % 19) for i in range(80)]
+    n_convs = max(9, int(12 * scale))
+    max_new = 16
+
+    def econf() -> EngineConfig:
+        return EngineConfig(max_batch_size=8, block_size=16,
+                            num_blocks=160, max_queue=256)
+
+    def model():
+        return TinyLM(vocab_size=64, prefill_token_delay_s=0.0008)
+
+    def run_phase(kv_routing: bool, shipping: bool):
+        fleet = ServeFleet(FleetConfig(
+            model_factory=model, num_replicas=3,
+            engine_config=econf(), shipping=shipping,
+            kv_routing=kv_routing, digest_max_age_s=0.01))
+        fleet.start()
+        try:
+            if shipping:
+                # One warm-up conversation seals the prompt on exactly
+                # one replica; the measured burst then finds the fleet
+                # in its steady state: one holder, two cold peers.
+                warm = fleet.submit(sys_prompt + [2, 3, 4], 4,
+                                    session_id="warmup")
+                for _ in warm.stream:
+                    pass
+                time.sleep(0.05)   # let the holder's digest publish
+            t0 = time.perf_counter()
+            convs = [fleet.submit(
+                sys_prompt + [2 + (i % 9), 3 + (i % 5), 4 + (i % 7)],
+                max_new, session_id=f"s{i}") for i in range(n_convs)]
+            tokens = 0
+            for c in convs:
+                tokens += sum(1 for _ in c.stream)
+            dt = time.perf_counter() - t0
+            ttfts = sorted((c.first_token_at - c.submitted_at)
+                           for c in convs if c.first_token_at)
+            shipped_ttfts = sorted(
+                (c.first_token_at - c.submitted_at)
+                for c in convs if c.shipped and c.first_token_at)
+            return (tokens / dt, ttfts, shipped_ttfts,
+                    fleet.prefix_ships, fleet.prefix_ship_tokens,
+                    fleet.lost_conversations)
+        finally:
+            fleet.stop()
+
+    cold_tok_s, cold_ttfts, _, _, _, cold_lost = run_phase(
+        kv_routing=False, shipping=False)
+    warm_tok_s, _, ship_ttfts, ships, ship_tokens, warm_lost = \
+        run_phase(kv_routing=True, shipping=True)
+    out["fleet_cold_tok_s"] = round(cold_tok_s, 1)
+    out["fleet_warm_tok_s"] = round(warm_tok_s, 1)
+    out["fleet_warm_vs_cold"] = round(warm_tok_s / max(cold_tok_s,
+                                                       1e-9), 2)
+    out["fleet_cold_ttft_p50_ms"] = round(
+        cold_ttfts[len(cold_ttfts) // 2] * 1e3, 1) if cold_ttfts else None
+    out["fleet_remote_warm_ttft_p50_ms"] = round(
+        ship_ttfts[len(ship_ttfts) // 2] * 1e3, 1) if ship_ttfts else None
+    out["fleet_ttft_cold_over_remote"] = (
+        round(out["fleet_cold_ttft_p50_ms"]
+              / max(out["fleet_remote_warm_ttft_p50_ms"], 1e-9), 2)
+        if ship_ttfts and cold_ttfts else None)
+    out["fleet_prefix_ships"] = ships
+    out["fleet_prefix_ship_tokens"] = ship_tokens
+
+    # -- phase 2: seeded kill mid-decode, recovery on a survivor -------
+    plan = FaultPlan(seed=19)
+    fleet = ServeFleet(FleetConfig(
+        model_factory=lambda: TinyLM(vocab_size=64,
+                                     step_delay_s=0.002),
+        num_replicas=3, engine_config=econf(),
+        digest_max_age_s=0.01, fault_plan=plan))
+    t_kill: list = []
+
+    def kill(dst: str):
+        t_kill.append(time.perf_counter())
+        fleet.kill_replica(dst)
+
+    plan.crash_after("replica-0", 8, method="token", on_crash=kill)
+    fleet.start()
+    try:
+        conv = fleet.submit(sys_prompt + [5], 40, session_id="r0")
+        got = list(conv.stream)
+        want = TinyLM(vocab_size=64).oracle(sys_prompt + [5], 40)
+        assert got == want, "recovered stream diverged from oracle"
+        assert conv.recovered_token_at is not None and t_kill
+        out["fleet_recovery_ms"] = round(
+            (conv.recovered_token_at - t_kill[0]) * 1e3, 1)
+        out["fleet_recoveries"] = fleet.recoveries
+        out["fleet_lost_conversations"] = (
+            cold_lost + warm_lost + fleet.lost_conversations)
+    finally:
+        fleet.stop()
+    return out
+
+
 def format_attribution(attr: Dict[str, Any]) -> str:
     """Human table for `python -m ray_tpu.perf --attribute`."""
     lines = [f"{'stage':28s} {'count':>8s} {'mean_us':>10s} "
@@ -1010,6 +1144,12 @@ def main() -> None:
                    help="run ONLY the in-process LLM-serving scenario "
                         "(continuous vs static batching, TTFT, 2x-"
                         "overload shedding); no cluster is booted")
+    p.add_argument("--fleet", action="store_true",
+                   help="run ONLY the multi-replica serving-fleet "
+                        "scenario (KV-aware routing, cross-replica "
+                        "prefix shipping warm-vs-cold, seeded replica "
+                        "kill -> conversation recovery); no cluster is "
+                        "booted")
     p.add_argument("--ring", action="store_true",
                    help="run ONLY the worker-direct dispatch-ring "
                         "bench (boots a ring-enabled cluster, measures "
@@ -1057,6 +1197,9 @@ def main() -> None:
         return
     if args.llm_serve:
         print(json.dumps(run_llm_serve_bench(scale=args.scale)))
+        return
+    if args.fleet:
+        print(json.dumps(run_fleet_bench(scale=args.scale)))
         return
     if args.ring:
         print(json.dumps(run_ring_microbench(scale=args.scale)))

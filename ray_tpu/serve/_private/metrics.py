@@ -136,9 +136,64 @@ def engine_metrics() -> Dict[str, Any]:
                 "serve_engine_time_to_first_token_seconds",
                 "Submit-to-first-token latency",
                 boundaries=_LATENCY_BOUNDARIES),
+            # Per-replica radix-index state (PR 19): what the dashboard
+            # /api/serve `prefix` section shows and what fleet digest
+            # freshness is judged against. Gauges (state, last-write-
+            # wins per replica tag), not counters — the engine's own
+            # fields stay the source of truth.
+            "prefix_nodes": Gauge(
+                "serve_prefix_index_nodes",
+                "Radix prefix-index nodes held by a replica's engine",
+                tag_keys=("replica",)),
+            "prefix_sealed": Gauge(
+                "serve_prefix_sealed_blocks",
+                "Sealed KV blocks pinned by a replica's prefix index",
+                tag_keys=("replica",)),
+            "prefix_hits_state": Gauge(
+                "serve_prefix_hits",
+                "Cumulative prefix-index admission hits on a replica",
+                tag_keys=("replica",)),
+            "prefix_evictions_state": Gauge(
+                "serve_prefix_evictions",
+                "Cumulative cold-prefix evictions on a replica",
+                tag_keys=("replica",)),
         }
 
     return _component("engine", build)
+
+
+def fleet_metrics() -> Dict[str, Any]:
+    """Multi-replica fleet-layer instruments (`serve_fleet_*`): KV-aware
+    routing outcomes, cross-replica prefix ships, and conversation
+    recoveries. Live in the process hosting the fleet router."""
+    def build():
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        return {
+            "ships": Counter(
+                "serve_fleet_prefix_ships",
+                "Sealed prefix chains shipped between replicas "
+                "(router-observed miss-with-remote-hit)"),
+            "ship_tokens": Counter(
+                "serve_fleet_prefix_ship_tokens",
+                "Prompt tokens covered by shipped prefix chains"),
+            "recoveries": Counter(
+                "serve_fleet_conversation_recoveries",
+                "Conversations requeued onto a survivor after replica "
+                "death"),
+            "route_prefix_hits": Counter(
+                "serve_fleet_route_prefix_hits",
+                "Requests routed to a replica because it held the "
+                "longest cached prefix"),
+            "route_sticky_hits": Counter(
+                "serve_fleet_route_sticky_hits",
+                "Requests kept on their session's replica"),
+            "replicas_alive": Gauge(
+                "serve_fleet_replicas_alive",
+                "Live replicas behind the fleet router"),
+        }
+
+    return _component("fleet", build)
 
 
 def replica_metrics() -> Dict[str, Any]:

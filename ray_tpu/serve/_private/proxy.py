@@ -33,6 +33,38 @@ from urllib.parse import parse_qs, urlparse
 _STREAM_END = object()
 
 
+def _overload_retry_after(e: BaseException) -> Optional[float]:
+    """Seconds a shed client should back off, when `e` is (or wraps) an
+    engine overload. Matched by type name, not import: the error may
+    have crossed a worker boundary and been reconstructed. Walks the
+    cause/context chain plus `RayTaskError.cause` (the remote original
+    rides that attribute, and `as_instanceof_cause()` mangles the
+    wrapper's own class name — hence the MRO scan)."""
+    seen = set()
+    stack: list = [e]
+    matched = False
+    while stack:
+        err = stack.pop()
+        if err is None or id(err) in seen:
+            continue
+        seen.add(id(err))
+        if any(c.__name__ == "EngineOverloadedError"
+               for c in type(err).__mro__):
+            matched = True
+            # The dual-inheritance wrapper is-a overload but carries the
+            # class-default None; the concrete value rides the chained
+            # original — keep walking before settling for the fallback.
+            ra = getattr(err, "retry_after_s", None)
+            try:
+                if ra:
+                    return float(ra)
+            except (TypeError, ValueError):
+                pass
+        stack.extend([err.__cause__, err.__context__,
+                      getattr(err, "cause", None)])
+    return 1.0 if matched else None
+
+
 class _AdmissionGate:
     """Pre-queue overload gate: in-flight cap first (503 — the system
     is saturated; retry against another ingress), then a token bucket
@@ -194,13 +226,20 @@ class HTTPProxy:
                 out = await self._dispatch(request, writer)
                 if out is None:
                     continue  # streaming path wrote its own response
-                status, body, ctype = out
+                # (status, body, ctype) or, with extra response headers
+                # (e.g. Retry-After on an overload 503), a 4th dict of
+                # header-name -> value bytes.
+                status, body, ctype = out[0], out[1], out[2]
+                extra = b""
+                if len(out) > 3 and out[3]:
+                    for k, v in out[3].items():
+                        extra += k + b": " + v + b"\r\n"
                 writer.write(
                     b"HTTP/1.1 " + status + b"\r\n"
                     b"Content-Type: " + ctype + b"\r\n"
                     b"Content-Length: " + str(len(body)).encode()
-                    + b"\r\n"
-                    b"Connection: keep-alive\r\n\r\n" + body)
+                    + b"\r\n" + extra
+                    + b"Connection: keep-alive\r\n\r\n" + body)
                 await writer.drain()
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
@@ -314,6 +353,20 @@ class HTTPProxy:
                 value = await asyncio.to_thread(
                     self._call_blocking, handle, request)
         except Exception as e:  # noqa: BLE001
+            retry = _overload_retry_after(e)
+            if retry is not None:
+                # The replica's engine shed the request (waiting queue
+                # full). Unlike a 500, this is backpressure: surface the
+                # engine's drain-rate-derived hint as Retry-After so
+                # well-behaved clients pace themselves instead of
+                # hammering a saturated fleet.
+                _count("overloaded")
+                secs = max(1, int(retry + 0.999))
+                return (b"503 Service Unavailable",
+                        f"engine overloaded; retry after "
+                        f"{retry:.2f}s".encode(),
+                        b"text/plain",
+                        {b"Retry-After": str(secs).encode()})
             _count("error")
             return (b"500 Internal Server Error",
                     f"{type(e).__name__}: {e}".encode(), b"text/plain")

@@ -28,6 +28,10 @@ class Router:
         # model's requests on the replica that already loaded it;
         # reference: the multiplexed scheduling of replica_scheduler.py).
         self._model_affinity: Dict[str, str] = {}
+        # session_id -> replica_id affinity (sticky sessions: keep a
+        # conversation on the replica whose KV cache already holds its
+        # history — the serve-layer half of fleet KV-aware routing).
+        self._session_affinity: Dict[str, str] = {}
         self._lock = threading.Lock()
         self._poller: Optional[threading.Thread] = None
 
@@ -95,11 +99,30 @@ class Router:
             except Exception:
                 time.sleep(1.0)  # controller restarting: retry
 
-    def _choose(self, model_id: Optional[str] = None) -> Tuple[str, Any]:
+    def _choose(self, model_id: Optional[str] = None,
+                session_id: Optional[str] = None) -> Tuple[str, Any]:
         with self._lock:
             replicas = list(self._replicas)
         if not replicas:
             raise _NoReplicas()
+        if session_id:
+            # Sticky sessions outrank model affinity: a conversation's
+            # KV blocks live on exactly one replica, so moving it costs
+            # a full re-prefill — worth more than a warm model slot.
+            # Same overload escape as model affinity (2x + 4 slack).
+            with self._lock:
+                pinned = self._session_affinity.get(session_id)
+            match = next((r for r in replicas if r[0] == pinned), None)
+            if match is not None:
+                others = [r for r in replicas if r[0] != pinned]
+                if not others:
+                    return match
+                alt = random.choice(others)
+                with self._lock:
+                    lp = self._inflight.get(match[0], 0)
+                    la = self._inflight.get(alt[0], 0)
+                if lp <= 2 * la + 4:
+                    return match
         if model_id:
             # Affinity first: the replica that last served this model has
             # it warm in its multiplex LRU — unless it's clearly
@@ -128,6 +151,7 @@ class Router:
     def assign(self, method_name: str, args: tuple, kwargs: dict,
                timeout_s: float = 30.0,
                model_id: Optional[str] = None,
+               session_id: Optional[str] = None,
                streaming: bool = False):
         """Pick a replica and submit; returns (replica_id, ObjectRef).
         Blocks (with backoff) while the deployment has no running
@@ -157,7 +181,8 @@ class Router:
             try:
                 while True:
                     try:
-                        replica_id, handle = self._choose(model_id)
+                        replica_id, handle = self._choose(model_id,
+                                                          session_id)
                         break
                     except _NoReplicas:
                         if time.monotonic() > deadline:
@@ -177,6 +202,8 @@ class Router:
                     self._inflight.get(replica_id, 0) + 1
                 if model_id:
                     self._model_affinity[model_id] = replica_id
+                if session_id:
+                    self._session_affinity[session_id] = replica_id
             try:
                 from ray_tpu.serve._private.metrics import router_metrics
 
@@ -187,6 +214,9 @@ class Router:
             metadata: Optional[dict] = None
             if model_id:
                 metadata = {"multiplexed_model_id": model_id}
+            if session_id:
+                metadata = dict(metadata or {})
+                metadata["session_id"] = session_id
             traceparent = current_traceparent()
             if traceparent:
                 metadata = dict(metadata or {})

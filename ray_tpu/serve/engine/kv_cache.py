@@ -274,6 +274,43 @@ class KVCacheManager:
                     freed += 1
             return freed
 
+    # -- cross-replica shipping (PR 19) --------------------------------
+    def read_block(self, block: int) -> np.ndarray:
+        """Copy of one allocated block's contents
+        (`[block_size, *kv_shape]`) — what prefix shipping exports. A
+        copy, not a view: the frame outlives the lock, and the source
+        block may COW/evict underneath a view."""
+        with self._lock:
+            if self._refs.get(block, 0) < 1:
+                raise ValueError(f"block {block} is not allocated")
+            return np.array(np.asarray(self._buffer[block]))
+
+    def install_block(self, values) -> Optional[int]:
+        """Allocate one free block, fill it with `values`
+        (`[block_size, *kv_shape]`) and return its index with ONE
+        reference held by the caller — the receiving half of prefix
+        shipping (the caller hands the reference to the prefix index
+        via `insert` + `release`). Asks the reclaimer under pressure
+        like `allocate`; returns None when genuinely full."""
+        values = np.asarray(values)
+        expect = (self.block_size,) + self.kv_shape
+        if tuple(values.shape) != expect:
+            raise ValueError(
+                f"install_block expects shape {expect}, got "
+                f"{tuple(values.shape)}")
+        while True:
+            with self._lock:
+                if self._free:
+                    b = self._free.pop()
+                    self._refs[b] = 1
+                    if self._ns is np:
+                        self._buffer[b] = values
+                    else:
+                        self._buffer = self._buffer.at[b].set(values)
+                    return b
+            if self._reclaimer is None or self._reclaimer(1) <= 0:
+                return None
+
     # -- storage -------------------------------------------------------
     def _slot(self, seq_id: str, pos: int) -> Tuple[int, int]:
         table = self._tables.get(seq_id)

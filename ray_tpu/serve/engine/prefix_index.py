@@ -38,14 +38,32 @@ thread; the lock only guards concurrent `stats()` readers.
 
 from __future__ import annotations
 
+import hashlib
 import heapq
 import itertools
+import struct
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+_ROOT_CHAIN = 0
+
+
+def chunk_chain_hash(parent: int, chunk: Sequence[int]) -> int:
+    """Stable 64-bit hash of a radix path extended by one sealed block's
+    token chunk. Chained (each node's hash folds its parent's in), so a
+    single hash identifies the whole prefix path — digest membership of
+    hash #i implies blocks [0, i] are all resident. blake2b, not
+    `hash()`: digests cross replica/process boundaries and Python's
+    builtin hash is salted per interpreter."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(parent.to_bytes(8, "little"))
+    h.update(struct.pack(f"<{len(chunk)}q", *[int(t) for t in chunk]))
+    return int.from_bytes(h.digest(), "little")
+
 
 class _Node:
-    __slots__ = ("chunk", "block", "children", "parent", "last_use")
+    __slots__ = ("chunk", "block", "children", "parent", "last_use",
+                 "chain")
 
     def __init__(self, chunk: Optional[Tuple[int, ...]],
                  block: Optional[int], parent: Optional["_Node"]):
@@ -54,6 +72,9 @@ class _Node:
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.parent = parent
         self.last_use = 0
+        # Chained path hash (chunk_chain_hash of the root->here chunk
+        # sequence) — what replica digests are made of.
+        self.chain = _ROOT_CHAIN
 
 
 class PrefixIndex:
@@ -72,6 +93,7 @@ class PrefixIndex:
         self.hit_tokens = 0
         self.inserted = 0
         self.evictions = 0
+        self.exports = 0
 
     # -- lookup --------------------------------------------------------
     def match(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
@@ -131,6 +153,7 @@ class PrefixIndex:
                     block = int(table[i])
                     self.cache.retain(block)
                     child = _Node(chunk, block, node)
+                    child.chain = chunk_chain_hash(node.chain, chunk)
                     node.children[chunk] = child
                     self._nodes += 1
                     self.inserted += 1
@@ -138,6 +161,52 @@ class PrefixIndex:
                 child.last_use = stamp
                 node = child
         return created
+
+    # -- fleet surface (PR 19) -----------------------------------------
+    def digest(self, max_entries: int = 4096) -> Dict[str, object]:
+        """Compact summary of the sealed prefix blocks this index holds:
+        the set of chained path hashes of every node (capped,
+        newest-use first under the cap). The fleet router matches an
+        incoming prompt's own chain hashes against these sets — because
+        hashes chain, membership of the prompt's i-th hash implies the
+        whole i-block prefix is resident here. This is what replicas
+        publish through the scrape path: O(nodes) ints, no token ids."""
+        with self._lock:
+            rows: List[Tuple[int, int]] = []   # (last_use, chain)
+            stack = list(self._root.children.values())
+            while stack:
+                nd = stack.pop()
+                stack.extend(nd.children.values())
+                rows.append((nd.last_use, nd.chain))
+            if len(rows) > max_entries:
+                rows.sort(reverse=True)
+                rows = rows[:max_entries]
+            return {"hashes": frozenset(c for _, c in rows),
+                    "nodes": self._nodes}
+
+    def export_chain(self, tokens: Sequence[int]
+                     ) -> List[Tuple[Tuple[int, ...], int]]:
+        """The matched FULL-block path for `tokens` as
+        [(chunk, block), ...] — what cross-replica prefix shipping
+        reads. Touches LRU stamps (an exported prefix is hot by
+        definition) but does not count as a hit/miss: shipping is not an
+        admission."""
+        toks = [int(t) for t in tokens]
+        bs = self.block_size
+        out: List[Tuple[Tuple[int, ...], int]] = []
+        with self._lock:
+            stamp = next(self._clock)
+            node = self._root
+            for i in range(len(toks) // bs):
+                child = node.children.get(tuple(toks[i * bs:(i + 1) * bs]))
+                if child is None:
+                    break
+                child.last_use = stamp
+                out.append((child.chunk, child.block))
+                node = child
+            if out:
+                self.exports += 1
+        return out
 
     # -- eviction ------------------------------------------------------
     def evict(self, n_blocks: int) -> int:
@@ -208,4 +277,5 @@ class PrefixIndex:
                 "hit_tokens": self.hit_tokens,
                 "inserted": self.inserted,
                 "evictions": self.evictions,
+                "exports": self.exports,
             }

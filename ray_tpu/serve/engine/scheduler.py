@@ -51,7 +51,14 @@ from ray_tpu.serve.engine.prefix_index import PrefixIndex
 
 
 class EngineOverloadedError(RuntimeError):
-    """The waiting queue is full — the caller should shed, not enqueue."""
+    """The waiting queue is full — the caller should shed, not enqueue.
+
+    `retry_after_s` (set at raise time from the engine's observed queue
+    drain rate) tells the shedding edge how long a well-behaved client
+    should back off — the proxy surfaces it as an HTTP `Retry-After`
+    header so overload backpressure is actionable, not just a 503."""
+
+    retry_after_s: Optional[float] = None
 
 
 @dataclass
@@ -64,6 +71,7 @@ class EngineConfig:
     policy: str = "continuous"     # "continuous" | "static"
     kv_array_ns: Any = None        # numpy (default) or jax.numpy
     prefix_sharing: bool = True    # adopt cached prompt prefixes
+    replica_tag: str = ""          # fleet identity (metrics/digests)
 
 
 class TokenStream:
@@ -217,17 +225,24 @@ class InferenceEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._ids = itertools.count()
+        self.replica_tag = self.config.replica_tag or "replica-0"
         # Counters (exported as serve_engine_* through stats()/metrics).
         self.steps = 0
         self.prefills = 0
         self.preemptions = 0
         self.tokens_generated = 0
         self.prefix_hit_tokens = 0
+        self.prefix_exports = 0
+        self.prefix_imports = 0
+        self.prefix_import_tokens = 0
         self.finished = 0
         self.prefill_s = 0.0
         self.decode_s = 0.0
         self._ttfts: List[float] = []
         self._pushed: Dict[str, float] = {}
+        # Retirement stamps feeding the queue-drain-rate estimate behind
+        # EngineOverloadedError.retry_after_s.
+        self._finish_stamps: deque = deque(maxlen=64)
 
     # -- submission ----------------------------------------------------
     def submit(self, prompt_tokens: Sequence[int],
@@ -256,8 +271,10 @@ class InferenceEngine:
                         stream=stream)
         with self._lock:
             if len(self._waiting) >= self.config.max_queue:
-                raise EngineOverloadedError(
+                err = EngineOverloadedError(
                     f"waiting queue full ({self.config.max_queue})")
+                err.retry_after_s = self._retry_after_locked()
+                raise err
             self._waiting.append(seq)
         self._work.set()
         return stream
@@ -269,6 +286,90 @@ class InferenceEngine:
     def batch_occupancy(self) -> int:
         with self._lock:
             return len(self._running)
+
+    # -- overload backpressure -----------------------------------------
+    def drain_rate(self) -> float:
+        """Sequences retired per second over the recent window (0.0
+        until two retirements have been observed)."""
+        stamps = list(self._finish_stamps)
+        if len(stamps) < 2:
+            return 0.0
+        dt = stamps[-1] - stamps[0]
+        return (len(stamps) - 1) / dt if dt > 0 else 0.0
+
+    def retry_after_s(self) -> float:
+        """How long a shed client should wait before retrying: the time
+        for the current waiting queue to drain one slot at the observed
+        retirement rate, clamped to [0.05, 30] so a cold engine still
+        hints something sane."""
+        with self._lock:
+            return self._retry_after_locked()
+
+    def _retry_after_locked(self) -> float:
+        rate = self.drain_rate()
+        depth = len(self._waiting) + 1
+        if rate <= 0.0:
+            return 1.0
+        return min(30.0, max(0.05, depth / rate))
+
+    # -- cross-replica prefix shipping (PR 19) -------------------------
+    def export_prefix(self, tokens: Sequence[int]):
+        """The cached FULL-block prefix of `tokens` as
+        (chunks, kv_arrays) — the holding side of cross-replica prefix
+        shipping. kv_arrays[i] is a copy of the block holding
+        chunks[i]; a block evicted between the index walk and the read
+        truncates the chain there (shipping is best-effort)."""
+        if self.prefix_index is None:
+            return [], []
+        chain = self.prefix_index.export_chain(tokens)
+        chunks: List = []
+        kvs: List = []
+        for chunk, block in chain:
+            try:
+                kvs.append(self.cache.read_block(block))
+            except ValueError:
+                break   # evicted under us: ship the intact head only
+            chunks.append(chunk)
+        if chunks:
+            self.prefix_exports += 1
+        return chunks, kvs
+
+    def import_prefix(self, chunks, kv_blocks) -> int:
+        """Adopt shipped sealed blocks into the LOCAL cache + prefix
+        index by reference-semantics insert: each block is installed
+        once, the index takes its usual single reference, and the next
+        admission matching this prefix adopts it exactly like a
+        locally-prefilled one. Chunks already indexed here keep the
+        first-indexed block (the duplicate import frees immediately).
+        Returns tokens now covered by the imported chain."""
+        if self.prefix_index is None:
+            return 0
+        installed: List[int] = []
+        flat: List[int] = []
+        for chunk, kv in zip(chunks, kv_blocks):
+            b = self.cache.install_block(kv)
+            if b is None:
+                break   # no capacity even after reclaim: partial adopt
+            installed.append(b)
+            flat.extend(int(t) for t in chunk)
+        if not installed:
+            return 0
+        self.prefix_index.insert(flat, installed)
+        for b in installed:
+            # Drop the installer's reference: newly indexed blocks stay
+            # held by the index; duplicates go straight back free.
+            self.cache.release(b)
+        adopted = len(installed) * self.config.block_size
+        self.prefix_imports += 1
+        self.prefix_import_tokens += adopted
+        return adopted
+
+    def prefix_digest(self, max_entries: int = 4096):
+        """The radix index summary the fleet router keys on (None when
+        prefix sharing is off)."""
+        if self.prefix_index is None:
+            return None
+        return self.prefix_index.digest(max_entries)
 
     # -- the iteration loop --------------------------------------------
     def step(self) -> bool:
@@ -509,6 +610,7 @@ class InferenceEngine:
                 self._running.remove(seq)
         self.cache.free(seq.seq_id)
         self.finished += 1
+        self._finish_stamps.append(time.perf_counter())
         seq.stream._finish(error)
 
     # -- hosting -------------------------------------------------------
@@ -574,6 +676,9 @@ class InferenceEngine:
             "preemptions": self.preemptions,
             "tokens_generated": self.tokens_generated,
             "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefix_exports": self.prefix_exports,
+            "prefix_imports": self.prefix_imports,
+            "prefix_import_tokens": self.prefix_import_tokens,
             "cow_copies": self.cache.cow_copies,
             "finished": self.finished,
             "running": running,
@@ -615,6 +720,18 @@ class InferenceEngine:
                     m["step_phase"].inc(cur - last,
                                         tags={"phase": phase})
                     self._pushed[attr] = cur
+            if self.prefix_index is not None:
+                # Per-replica radix-index state on the scrape path —
+                # the dashboard's /api/serve `prefix` section and the
+                # fleet router's digest freshness both ride this.
+                pst = self.prefix_index.stats()
+                tags = {"replica": self.replica_tag}
+                m["prefix_nodes"].set(float(pst["nodes"]), tags=tags)
+                m["prefix_sealed"].set(
+                    float(self.prefix_index.held_blocks()), tags=tags)
+                m["prefix_hits_state"].set(float(pst["hits"]), tags=tags)
+                m["prefix_evictions_state"].set(
+                    float(pst["evictions"]), tags=tags)
         except Exception:
             pass  # metrics must never fail the decode loop
 

@@ -154,12 +154,14 @@ class DeploymentHandle:
     def __init__(self, deployment_name: str, controller_handle,
                  method_name: str = "__call__",
                  multiplexed_model_id: str = "",
-                 stream: bool = False):
+                 stream: bool = False,
+                 session_id: str = ""):
         self.deployment_name = deployment_name
         self._controller = controller_handle
         self._method_name = method_name
         self._multiplexed_model_id = multiplexed_model_id
         self._stream = stream
+        self._session_id = session_id
         # Shared one-slot holder: every options() variant of this handle
         # uses the SAME Router (and its poller thread + model-affinity
         # cache) — a per-request options() call must never mint routers.
@@ -176,14 +178,17 @@ class DeploymentHandle:
 
     def options(self, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                session_id: Optional[str] = None) -> "DeploymentHandle":
         """Per-request options (reference: handle.options): method_name
         routes to a named method; multiplexed_model_id tags the request
         for model-multiplexed replicas (serve/multiplex.py) and makes the
         router prefer a replica with that model already warm;
         stream=True makes `.remote()` return a
         DeploymentResponseGenerator that yields items as the replica's
-        generator produces them (token streaming)."""
+        generator produces them (token streaming); session_id pins a
+        conversation to one replica (sticky sessions: its KV-cache
+        history lives there — re-routing costs a full re-prefill)."""
         dup = DeploymentHandle(
             self.deployment_name, self._controller,
             method_name=(self._method_name if method_name is None
@@ -191,7 +196,9 @@ class DeploymentHandle:
             multiplexed_model_id=(
                 self._multiplexed_model_id
                 if multiplexed_model_id is None else multiplexed_model_id),
-            stream=self._stream if stream is None else stream)
+            stream=self._stream if stream is None else stream,
+            session_id=(self._session_id if session_id is None
+                        else session_id))
         dup._DeploymentHandle__router_slot = self.__router_slot
         return dup
 
@@ -203,11 +210,13 @@ class DeploymentHandle:
             replica_id, gen = self._router.assign(
                 method_name, args, kwargs,
                 model_id=self._multiplexed_model_id or None,
+                session_id=self._session_id or None,
                 streaming=True)
             return DeploymentResponseGenerator(self, replica_id, gen)
         replica_id, ref = self._router.assign(
             method_name, args, kwargs,
-            model_id=self._multiplexed_model_id or None)
+            model_id=self._multiplexed_model_id or None,
+            session_id=self._session_id or None)
         resp = DeploymentResponse(self, replica_id, ref)
         resp._args, resp._kwargs = args, kwargs
         return resp
@@ -216,4 +225,4 @@ class DeploymentHandle:
         return (DeploymentHandle,
                 (self.deployment_name, self._controller,
                  self._method_name, self._multiplexed_model_id,
-                 self._stream))
+                 self._stream, self._session_id))
