@@ -425,6 +425,19 @@ class DashboardHead:
                 pslot(s["tags"].get("replica", "?"))[key] = s["value"]
         out: Dict[str, Any] = {"deployments": deployments,
                                "ingress": ingress, "prefix": prefix}
+        # Per-replica KV block-pool placement (PR 20): each engine
+        # pushes serve_engine_kv_pool_bytes tagged with its replica id
+        # and where the pool lives (`device` = jax array read in-jit by
+        # paged decode; `host` = numpy) — an operator can see at a
+        # glance which replicas run the device data plane.
+        kv_pool: Dict[str, Dict[str, Any]] = {}
+        for s in m.get("serve_engine_kv_pool_bytes", []):
+            kv_pool[s["tags"].get("replica", "?")] = {
+                "bytes": float(s.get("value", 0.0)),
+                "residency": s["tags"].get("residency", "?"),
+            }
+        if kv_pool:
+            out["kv_pool"] = kv_pool
         # Fleet control-layer totals (KV-aware routing + shipping +
         # recovery), when a fleet is running anywhere in the cluster.
         fleet: Dict[str, float] = {}

@@ -127,7 +127,20 @@ def engine_metrics() -> Dict[str, Any]:
             "step_phase": Counter(
                 "serve_engine_step_seconds",
                 "Cumulative model time split by phase",
-                tag_keys=("phase",)),     # prefill | decode
+                # prefill | decode | kv_gather | model_step | kv_write
+                # (the last three split the decode step — paged decode
+                # collapses kv_gather to table padding)
+                tag_keys=("phase",)),
+            "kv_pool_bytes": Gauge(
+                "serve_engine_kv_pool_bytes",
+                "Preallocated KV block-pool size, tagged with where "
+                "the pool lives (device: jax array mutated via "
+                "donated jits; host: numpy)",
+                tag_keys=("replica", "residency")),
+            "jit_evictions": Counter(
+                "serve_engine_jit_bucket_evictions",
+                "Compiled shape buckets dropped by the engine model's "
+                "LRU jit caches"),
             "shed": Counter(
                 "serve_engine_shed_requests",
                 "Requests shed at the ingress before queuing",

@@ -31,9 +31,19 @@ The manager owns two things:
   buffer itself, with `write` / `write_range` / `gather` translating
   logical token positions through the table. The buffer namespace
   is pluggable: numpy (default — zero-copy views, exact, fast under
-  `JAX_PLATFORMS=cpu`) or `jax.numpy` (device-resident cache; writes go
-  through `.at[].set`, which XLA performs in place when the buffer is
-  not aliased).
+  `JAX_PLATFORMS=cpu`) or a **device-resident pool**
+  (`device_pool=True`): the buffer lives as one `jax.numpy` array and
+  every mutation (`write`, `write_range`, COW privatize,
+  `install_block`, the batched `write_step`) goes through a
+  donated-argument jitted update — the pool is threaded through the
+  jit and donated back, so XLA aliases input to output and steady-state
+  decode neither copies the pool nor allocates a second one. The paged
+  decode path (`EngineConfig(paged_decode=True)`) reads the pool
+  *inside* the model's compiled step via `jnp.take` over block tables
+  (`with_pool` hands the live buffer to the dispatch under the lock),
+  which removes the per-step host `gather`/pad entirely; the
+  `host_gathers` counter proves it (the paged perf guard asserts it
+  stays zero across a whole decode run).
 
 Determinism contract (the scheduler's loop must never crash on OOM):
 `allocate` is atomic — it either extends the table (and privatizes the
@@ -58,6 +68,67 @@ class CacheOverflowError(RuntimeError):
     the one OOM shape that cannot be fixed by preempting someone else."""
 
 
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class _DevicePoolOps:
+    """Donated-arg jitted mutations over the device pool, compiled once
+    per pool shape (token writes go through `scatter`, whose row count
+    pads to pow2 buckets — a handful of compiles covers every range
+    length and batch size, no per-offset churn).
+
+    Every op takes the pool as argument 0 with `donate_argnums=0`: XLA
+    aliases the input buffer to the output, the update happens in place
+    on the accelerator, and the caller re-binds `self._buffer` to the
+    returned handle. The previous handle is invalidated by donation —
+    which is exactly why all pool access goes through the manager's
+    lock (`with_pool` for in-jit readers)."""
+
+    def __init__(self, block_size: int, kv_shape: Tuple[int, ...]):
+        import jax
+
+        def copy_block(pool, dst, src):
+            return pool.at[dst].set(pool[src])
+
+        def set_block(pool, block, vals):
+            return pool.at[block].set(vals)
+
+        def scatter(pool, blocks, offs, vals):
+            # Batched token write: one (block, off) slot per row —
+            # a whole prefill range or one decode step's batch in a
+            # single dispatch. Padding rows carry block == num_blocks
+            # (out of range) and are dropped, so one compile per pow2
+            # row bucket suffices.
+            return pool.at[blocks, offs].set(vals, mode="drop")
+
+        self.copy_block = jax.jit(copy_block, donate_argnums=0)
+        self.set_block = jax.jit(set_block, donate_argnums=0)
+        self.scatter = jax.jit(scatter, donate_argnums=0)
+
+
+_POOL_OPS: Dict[Tuple[int, Tuple[int, ...]], _DevicePoolOps] = {}
+_POOL_OPS_LOCK = threading.Lock()
+
+
+def _pool_ops(block_size: int,
+              kv_shape: Tuple[int, ...]) -> _DevicePoolOps:
+    """Process-wide ops cache: the jitted mutations close over nothing
+    but shapes, so every manager with the same block geometry shares
+    one set of compiled executables — a fresh engine must not re-pay
+    XLA compiles for the same pool shape (jit caches live on the
+    function object, and per-manager ops would make every cache cold)."""
+    key = (block_size, kv_shape)
+    with _POOL_OPS_LOCK:
+        ops = _POOL_OPS.get(key)
+        if ops is None:
+            ops = _POOL_OPS[key] = _DevicePoolOps(block_size, kv_shape)
+        return ops
+
+
 class KVCacheManager:
     """Fixed-size refcounted blocks in one preallocated buffer +
     per-sequence block tables. Thread-safe (the engine loop and
@@ -65,16 +136,35 @@ class KVCacheManager:
 
     def __init__(self, num_blocks: int, block_size: int,
                  kv_shape: Tuple[int, ...] = (), dtype=np.float32,
-                 array_ns=None):
+                 array_ns=None, device_pool: bool = False):
         if num_blocks <= 0 or block_size <= 0:
             raise ValueError("num_blocks and block_size must be positive")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.kv_shape = tuple(kv_shape)
+        if device_pool and array_ns is None:
+            try:
+                import jax.numpy as jnp
+
+                array_ns = jnp
+            except Exception:  # jax unavailable: degrade to host pool
+                array_ns = np
         self._ns = array_ns if array_ns is not None else np
+        self._device = self._ns is not np
+        self._dtype = dtype
+        self._ops: Optional[_DevicePoolOps] = None
+        if self._device:
+            self._ops = _pool_ops(self.block_size, self.kv_shape)
         # THE preallocated cache: every sequence's KV lives here.
         self._buffer = self._ns.zeros(
             (self.num_blocks, self.block_size) + self.kv_shape, dtype)
+        # Data-movement honesty counters: `host_gathers` counts calls
+        # that materialize per-sequence KV for host-side consumption
+        # (the cost the paged path exists to remove — its perf guard
+        # asserts this stays 0 across a decode run); `pool_updates`
+        # counts donated in-place pool mutations on the device path.
+        self.host_gathers = 0
+        self.pool_updates = 0
         # LIFO free list: recently-freed blocks are cache-warm.
         self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
         self._refs: Dict[int, int] = {}          # block -> holder count
@@ -84,7 +174,9 @@ class KVCacheManager:
         # lazily after any table mutation instead of re-converting the
         # Python list on every decode step.
         self._table_arrays: Dict[str, np.ndarray] = {}
-        self._lock = threading.Lock()
+        # Reentrant: `with_pool` callbacks legitimately read tables /
+        # lengths through the public accessors while the lock is held.
+        self._lock = threading.RLock()
         self.cow_copies = 0
         self.adoptions = 0
         # Under block pressure, `allocate` asks the reclaimer to free
@@ -306,7 +398,10 @@ class KVCacheManager:
                     if self._ns is np:
                         self._buffer[b] = values
                     else:
-                        self._buffer = self._buffer.at[b].set(values)
+                        self._buffer = self._ops.set_block(
+                            self._buffer, b,
+                            self._ns.asarray(values, self._dtype))
+                        self.pool_updates += 1
                     return b
             if self._reclaimer is None or self._reclaimer(1) <= 0:
                 return None
@@ -336,7 +431,8 @@ class KVCacheManager:
         if self._ns is np:
             self._buffer[new] = self._buffer[old]
         else:
-            self._buffer = self._buffer.at[new].set(self._buffer[old])
+            self._buffer = self._ops.copy_block(self._buffer, new, old)
+            self.pool_updates += 1
         self._refs[new] = 1
         self._refs[old] -= 1          # shared => was > 1, stays >= 1
         table[block_idx] = new
@@ -353,6 +449,34 @@ class KVCacheManager:
             self._privatize_locked(seq_id, idx)
         return table[idx], off
 
+    def _pool_scatter(self, blocks: np.ndarray, offs: np.ndarray,
+                      values, n: int) -> None:
+        """ONE donated scatter for `n` token rows: a whole prefill
+        range (any number of blocks, any offsets) or one decode step's
+        batch lands in a single dispatch. Rows pad to a pow2 bucket so
+        compiles stay bounded; padding rows point past the pool and
+        drop. Host payloads pad in numpy (one transfer, one dispatch);
+        device payloads (a paged prefill's tail KV) pad on-device so
+        they never round-trip through the host."""
+        n_pad = _next_pow2(max(n, 1))
+        b = np.full((n_pad,), self.num_blocks, np.int32)
+        o = np.zeros((n_pad,), np.int32)
+        b[:n] = blocks[:n]
+        o[:n] = offs[:n]
+        if hasattr(values, "block_until_ready"):   # already on device
+            vals = self._ns.asarray(values, self._dtype)
+            if n_pad != n:
+                vals = self._ns.zeros(
+                    (n_pad,) + self.kv_shape, self._dtype).at[:n].set(vals)
+        else:
+            padded = np.zeros((n_pad,) + self.kv_shape,
+                              np.dtype(self._dtype))
+            padded[:n] = np.asarray(values)[:n]
+            vals = self._ns.asarray(padded)
+        self._buffer = self._ops.scatter(
+            self._buffer, self._ns.asarray(b), self._ns.asarray(o), vals)
+        self.pool_updates += 1
+
     def write(self, seq_id: str, pos: int, value) -> None:
         """Store one token's KV entry at logical position `pos`. A
         write into a shared block privatizes it first (COW)."""
@@ -361,29 +485,125 @@ class KVCacheManager:
             if self._ns is np:
                 self._buffer[block, off] = value
             else:
-                self._buffer = self._buffer.at[block, off].set(value)
+                self._pool_scatter(np.asarray([block], np.int32),
+                                   np.asarray([off], np.int32),
+                                   np.asarray(value)[None], 1)
             self._lens[seq_id] = max(self._lens.get(seq_id, 0), pos + 1)
 
     def write_range(self, seq_id: str, start: int, values) -> None:
         """Store KV entries for positions [start, start+len(values)) —
-        the prefill bulk write, one block-sized slice at a time. Shared
-        blocks in the range privatize first (COW)."""
+        the prefill bulk write. Shared blocks in the range privatize
+        first (COW). The numpy pool writes block-sized slices in
+        place; the device pool resolves every token's (block, off)
+        slot and lands the whole range in one donated scatter."""
         n = len(values)
         with self._lock:
-            pos = start
-            written = 0
-            while written < n:
-                block, off = self._writable_block(seq_id, pos)
-                take = min(self.block_size - off, n - written)
-                chunk = values[written:written + take]
-                if self._ns is np:
-                    self._buffer[block, off:off + take] = chunk
-                else:
-                    self._buffer = self._buffer.at[
-                        block, off:off + take].set(chunk)
-                written += take
-                pos += take
+            if self._ns is np:
+                pos = start
+                written = 0
+                while written < n:
+                    block, off = self._writable_block(seq_id, pos)
+                    take = min(self.block_size - off, n - written)
+                    self._buffer[block, off:off + take] = \
+                        values[written:written + take]
+                    written += take
+                    pos += take
+            elif n:
+                blocks = np.empty((n,), np.int32)
+                offs = np.empty((n,), np.int32)
+                pos = start
+                i = 0
+                while i < n:
+                    block, off = self._writable_block(seq_id, pos)
+                    take = min(self.block_size - off, n - i)
+                    blocks[i:i + take] = block
+                    offs[i:i + take] = np.arange(off, off + take)
+                    i += take
+                    pos += take
+                self._pool_scatter(blocks, offs, values, n)
             self._lens[seq_id] = max(self._lens.get(seq_id, 0), start + n)
+
+    def write_step(self, entries: Sequence[Tuple[str, int]],
+                   values) -> None:
+        """Batched one-token-per-sequence decode-step write: row i of
+        `values` (`[b_pad, *kv_shape]`) lands at `entries[i]`'s
+        (seq_id, pos) slot. Padding rows past `len(entries)` are
+        ignored (device path: scattered to an out-of-range block and
+        dropped, so one compile covers every batch bucket). Shared
+        blocks privatize first (COW), same as `write`."""
+        b = len(entries)
+        rows = int(values.shape[0])
+        with self._lock:
+            blocks = np.full((rows,), self.num_blocks, np.int32)
+            offs = np.zeros((rows,), np.int32)
+            for i, (seq_id, pos) in enumerate(entries):
+                blk, off = self._writable_block(seq_id, pos)
+                blocks[i] = blk
+                offs[i] = off
+                self._lens[seq_id] = max(
+                    self._lens.get(seq_id, 0), pos + 1)
+            if self._ns is np:
+                vals = np.asarray(values)
+                self._buffer[blocks[:b], offs[:b]] = vals[:b]
+            else:
+                self._buffer = self._ops.scatter(
+                    self._buffer, self._ns.asarray(blocks),
+                    self._ns.asarray(offs),
+                    self._ns.asarray(values, self._dtype))
+                self.pool_updates += 1
+
+    def with_pool(self, fn):
+        """Run `fn(pool)` on the live device buffer under the cache
+        lock — the in-jit reader's entry point (paged prefill passes
+        the pool straight into the model's compiled step). Donation
+        from a concurrent writer invalidates the previous Python
+        handle, so the dispatch must happen before any other thread
+        re-binds the buffer; holding the lock across `fn` guarantees
+        exactly that. The pool argument must be treated as read-only —
+        mutations go through the manager's donated ops."""
+        with self._lock:
+            return fn(self._buffer)
+
+    def mutate_pool(self, fn):
+        """Run ``fn(pool) -> (result, new_pool)`` under the cache lock
+        and re-bind the buffer. For callers that hand the pool to a
+        DONATING jit (which invalidates the old handle) without going
+        through `paged_step`'s slot resolution — e.g. a read-only
+        full-prefix-hit decode, where the fused step runs with an empty
+        write list and the returned pool is byte-identical."""
+        with self._lock:
+            result, new_pool = fn(self._buffer)
+            self._buffer = new_pool
+            if self._device:
+                self.pool_updates += 1
+            return result
+
+    def paged_step(self, entries: Sequence[Tuple[str, int]], fn):
+        """One fused paged decode step. Resolves each entry's
+        (seq_id, pos) to a private (block, off) slot (COW backstop,
+        same as `write`), calls ``fn(pool, blocks, offs)`` — the
+        model's in-place compiled step, which gathers KV, computes,
+        scatters the new tokens' KV at the given slots and returns
+        ``(result, new_pool)`` with the pool DONATED — then re-binds
+        the buffer and records the written lengths. One jit dispatch
+        per decode step; the KV payload never exists outside the pool.
+        All under the cache lock: readers can neither see the
+        pre-write pool after lens advance nor race the donation."""
+        with self._lock:
+            blocks: List[int] = []
+            offs: List[int] = []
+            for seq_id, pos in entries:
+                blk, off = self._writable_block(seq_id, pos)
+                blocks.append(blk)
+                offs.append(off)
+            result, new_pool = fn(self._buffer, blocks, offs)
+            self._buffer = new_pool
+            if self._device:
+                self.pool_updates += 1
+            for seq_id, pos in entries:
+                self._lens[seq_id] = max(
+                    self._lens.get(seq_id, 0), pos + 1)
+            return result
 
     def _table_array(self, seq_id: str) -> np.ndarray:
         arr = self._table_arrays.get(seq_id)
@@ -398,6 +618,7 @@ class KVCacheManager:
         gather over whole blocks through the precomputed per-sequence
         index array (no per-position work)."""
         with self._lock:
+            self.host_gathers += 1
             n = self._lens.get(seq_id, 0) if length is None else length
             if n == 0:
                 return self._buffer[0, 0:0]
@@ -411,6 +632,20 @@ class KVCacheManager:
                     self._buffer[self._ns.asarray(idx)],
                     (nblocks * self.block_size,) + self.kv_shape)
             return out[:n]
+
+    @property
+    def pool_residency(self) -> str:
+        """Where the block pool lives: `device` (jax array mutated via
+        donated jits) or `host` (numpy)."""
+        return "device" if self._device else "host"
+
+    @property
+    def pool_bytes(self) -> int:
+        """Size of the preallocated block pool in bytes."""
+        n = self.num_blocks * self.block_size
+        for d in self.kv_shape:
+            n *= d
+        return n * np.dtype(self._dtype).itemsize
 
     def stats(self) -> Dict[str, float]:
         with self._lock:
@@ -426,4 +661,8 @@ class KVCacheManager:
                 "shared_blocks": shared,
                 "cow_copies": self.cow_copies,
                 "adoptions": self.adoptions,
+                "pool_residency": self.pool_residency,
+                "pool_bytes": self.pool_bytes,
+                "host_gathers": self.host_gathers,
+                "pool_updates": self.pool_updates,
             }

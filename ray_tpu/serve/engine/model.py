@@ -35,6 +35,7 @@ Two implementations:
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -45,6 +46,33 @@ def _next_pow2(n: int) -> int:
     while p < n:
         p <<= 1
     return p
+
+
+class _JitLRU(OrderedDict):
+    """Bounded LRU of compiled shape buckets. Bucket pairs accumulate
+    over a replica's lifetime ((batch, seq) for decode, (tail, prefix)
+    for cached prefill, and the paged triples add a block dimension) —
+    unbounded dicts would pin every compiled executable forever. `get`
+    refreshes recency; inserting past `cap` drops the coldest bucket
+    (the executable is re-built on next use) and counts the eviction."""
+
+    def __init__(self, cap: int):
+        super().__init__()
+        self.cap = max(1, int(cap))
+        self.evictions = 0
+
+    def get(self, key, default=None):
+        if key in self:
+            self.move_to_end(key)
+            return super().__getitem__(key)
+        return default
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self.move_to_end(key)
+        while len(self) > self.cap:
+            self.popitem(last=False)
+            self.evictions += 1
 
 
 class TinyLM:
@@ -62,6 +90,7 @@ class TinyLM:
     kv_token_shape: Tuple[int, ...] = (1,)
     kv_dtype = np.float32
     supports_prefix_prefill = True
+    supports_paged = True
 
     def __init__(self, vocab_size: int = 32, eos_period: int = 0,
                  step_delay_s: float = 0.0,
@@ -123,6 +152,53 @@ class TinyLM:
             new_kv[i, 0] = float(last_tokens[i])
         return logits, new_kv
 
+    def _pool_gather(self, pool, table: Sequence[int], n: int,
+                     block_size: int) -> np.ndarray:
+        """Host gather of positions [0, n) straight from the pool via a
+        block table — the toy model's paged path. TinyLM is the oracle,
+        not the perf subject, so reading the pool to host here is fine;
+        what matters is that the BLOCK TABLE (not a pre-gathered view)
+        drives the read, so table bugs still change tokens."""
+        if n == 0:
+            return np.zeros((0,) + self.kv_token_shape, np.float32)
+        nb = (n + block_size - 1) // block_size
+        pool_np = np.asarray(pool, np.float32)
+        idx = np.asarray(list(table)[:nb], np.int64)
+        return pool_np[idx].reshape((-1,) + self.kv_token_shape)[:n]
+
+    def decode_paged(self, pool, block_tables: List[Sequence[int]],
+                     last_tokens: Sequence[int],
+                     positions: Sequence[int],
+                     write_blocks: Sequence[int],
+                     write_offs: Sequence[int], block_size: int):
+        """Fused paged step: read through the block tables, decode,
+        write each new token's KV into its (block, off) slot, and
+        return ``(logits, new_pool)``. `write_blocks` may be shorter
+        than the batch (empty = read-only step, e.g. a full prefix
+        hit). The oracle keeps everything on host; only the write-back
+        shape matters here."""
+        kvs = [self._pool_gather(pool, block_tables[i],
+                                 int(positions[i]), block_size)
+               for i in range(len(last_tokens))]
+        logits, new_kv = self.decode(kvs, last_tokens, positions)
+        k = min(len(write_blocks), len(last_tokens))
+        if isinstance(pool, np.ndarray):
+            for i in range(k):
+                pool[write_blocks[i], write_offs[i]] = new_kv[i]
+        elif k:
+            pool = pool.at[np.asarray(write_blocks[:k], np.int32),
+                           np.asarray(write_offs[:k], np.int32)].set(
+                np.asarray(new_kv[:k], dtype=pool.dtype))
+        return logits, pool
+
+    def prefill_paged(self, tokens: Sequence[int], pool,
+                      block_table: Sequence[int], prefix_len: int,
+                      block_size: int):
+        prefix_kv = (self._pool_gather(pool, block_table, prefix_len,
+                                       block_size)
+                     if prefix_len else None)
+        return self.prefill(tokens, prefix_kv)
+
     def oracle(self, prompt: Sequence[int], max_new_tokens: int
                ) -> List[int]:
         """Reference generation, no cache: what the engine MUST emit."""
@@ -153,8 +229,10 @@ class TransformerEngineModel:
     """
 
     supports_prefix_prefill = True
+    supports_paged = True
 
-    def __init__(self, params, cfg, max_batch_size: int = 8):
+    def __init__(self, params, cfg, max_batch_size: int = 8,
+                 jit_cache_cap: int = 32):
         import jax.numpy as jnp
 
         if cfg.is_moe:
@@ -167,13 +245,26 @@ class TransformerEngineModel:
         self.kv_token_shape = (cfg.n_layers, 2, cfg.n_heads, cfg.head_dim)
         self.kv_dtype = np.float32
         self._max_batch = max_batch_size
-        self._prefill_jit: Dict[int, object] = {}   # S_pad -> fn
-        self._prefill_cached_jit: Dict[Tuple[int, int], object] = {}
-        self._decode_jit: Dict[Tuple[int, int], object] = {}
+        self._prefill_jit = _JitLRU(jit_cache_cap)   # S_pad -> fn
+        self._prefill_cached_jit = _JitLRU(jit_cache_cap)
+        self._decode_jit = _JitLRU(jit_cache_cap)
+        self._decode_paged_jit = _JitLRU(jit_cache_cap)
+        self._prefill_paged_jit = _JitLRU(jit_cache_cap)
         self.prefill_calls = 0
         self.prefill_tokens = 0
         self.decode_calls = 0
+        self.jit_compiles = 0
         self._jnp = jnp
+
+    @property
+    def jit_cache_evictions(self) -> int:
+        """Compiled shape buckets dropped by the LRU caps (the
+        `serve_engine_jit_bucket_evictions` counter)."""
+        return (self._prefill_jit.evictions
+                + self._prefill_cached_jit.evictions
+                + self._decode_jit.evictions
+                + self._decode_paged_jit.evictions
+                + self._prefill_paged_jit.evictions)
 
     # -- shared math ---------------------------------------------------
     @staticmethod
@@ -194,6 +285,7 @@ class TransformerEngineModel:
         from ray_tpu.models.transformer import _rmsnorm
         from ray_tpu.ops.rotary import apply_rotary, rotary_freqs
 
+        self.jit_compiles += 1
         cfg = self._cfg
         h, hd = cfg.n_heads, cfg.head_dim
 
@@ -241,10 +333,14 @@ class TransformerEngineModel:
 
         return jax.jit(run)
 
-    def _build_prefill_cached(self, t_pad: int, p_pad: int):
-        """Prefill-from-offset: tail queries attend over the adopted
-        prefix KV plus the tail's own keys — the prompt's matched head
-        is never recomputed. One jit per (tail, prefix) bucket pair."""
+    def _prefill_cached_math(self, params, tail_tokens, p_len, t_len,
+                             prefix, t_pad: int, p_pad: int):
+        """Traced body of prefill-from-offset: tail queries attend over
+        the prefix KV plus the tail's own keys — the prompt's matched
+        head is never recomputed. `prefix` rows beyond `p_len` are
+        masked out of attention (`pref_valid`), so callers may hand in
+        zero padding (host path) or stale pool garbage (paged gather)
+        interchangeably."""
         import jax
         import jax.numpy as jnp
 
@@ -254,69 +350,107 @@ class TransformerEngineModel:
         cfg = self._cfg
         h, hd = cfg.n_heads, cfg.head_dim
 
+        act = jnp.float32
+        x = params["embed"][tail_tokens].astype(act)[None]  # [1,T,D]
+        cos, sin = rotary_freqs(hd, cfg.max_seq_len, cfg.rope_theta)
+        tpos = p_len + jnp.arange(t_pad)      # absolute positions
+        tail_valid = jnp.arange(t_pad) < t_len
+        pref_valid = jnp.arange(p_pad) < p_len
+        causal_tt = ((jnp.arange(t_pad)[:, None]
+                      >= jnp.arange(t_pad)[None, :])
+                     & tail_valid[None, :])
+        prefix_l = prefix.transpose(1, 0, 2, 3, 4)  # [L,P,2,H,hd]
+
+        def layer(x, inputs):
+            lp, pkv = inputs               # pkv [P, 2, H, hd]
+            y = _rmsnorm(x, lp["ln1"])
+            qkv = jnp.einsum("bsd,dkh->kbsh", y,
+                             lp["wqkv"].astype(act))
+            q = qkv[0].reshape(1, t_pad, h, hd)
+            k = qkv[1].reshape(1, t_pad, h, hd)
+            v = qkv[2].reshape(1, t_pad, h, hd)
+            q = apply_rotary(q, cos, sin, tpos)
+            k = apply_rotary(k, cos, sin, tpos)
+            pk = pkv[None, :, 0]           # [1, P, H, hd]
+            pv = pkv[None, :, 1]
+            scale = hd ** -0.5
+            sc_p = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, pk,
+                preferred_element_type=jnp.float32) * scale
+            sc_t = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k,
+                preferred_element_type=jnp.float32) * scale
+            sc_p = jnp.where(pref_valid[None, None, None, :],
+                             sc_p, -1e30)
+            sc_t = jnp.where(causal_tt[None, None], sc_t, -1e30)
+            probs = jax.nn.softmax(
+                jnp.concatenate([sc_p, sc_t], axis=-1),
+                axis=-1).astype(act)
+            o = (jnp.einsum("bhqk,bkhd->bqhd",
+                            probs[..., :p_pad], pv)
+                 + jnp.einsum("bhqk,bkhd->bqhd",
+                              probs[..., p_pad:], v))
+            x = x + (o.reshape(1, t_pad, h * hd)
+                     @ lp["wo"].astype(act))
+            y = _rmsnorm(x, lp["ln2"])
+            gu = jnp.einsum("bsd,dkf->kbsf", y,
+                            lp["w13"].astype(act))
+            x = x + (jax.nn.silu(gu[0]) * gu[1]) @ lp["w2"].astype(act)
+            kv = jnp.stack([k[0], v[0]], axis=1)   # [T, 2, H, hd]
+            return x, kv
+
+        x, kvs = jax.lax.scan(layer, x, (params["layers"], prefix_l))
+        x = _rmsnorm(x, params["ln_f"])
+        last = x[0, t_len - 1]
+        logits = jnp.einsum("d,vd->v", last,
+                            params["embed"].astype(act))
+        # kvs [L, T, 2, H, hd] -> [T, L, 2, H, hd]
+        return logits, kvs.transpose(1, 0, 2, 3, 4)
+
+    def _build_prefill_cached(self, t_pad: int, p_pad: int):
+        """One jit per (tail, prefix) bucket pair — prefix handed in as
+        a gathered host array (zero beyond p_len)."""
+        import jax
+
+        self.jit_compiles += 1
+
         def run(params, tail_tokens, p_len, t_len, prefix):
-            # tail_tokens [T_pad] int32 (zero-padded), p_len/t_len
-            # scalars, prefix [P_pad, L, 2, H, hd] (zero beyond p_len).
-            act = jnp.float32
-            x = params["embed"][tail_tokens].astype(act)[None]  # [1,T,D]
-            cos, sin = rotary_freqs(hd, cfg.max_seq_len, cfg.rope_theta)
-            tpos = p_len + jnp.arange(t_pad)      # absolute positions
-            tail_valid = jnp.arange(t_pad) < t_len
-            pref_valid = jnp.arange(p_pad) < p_len
-            causal_tt = ((jnp.arange(t_pad)[:, None]
-                          >= jnp.arange(t_pad)[None, :])
-                         & tail_valid[None, :])
-            prefix_l = prefix.transpose(1, 0, 2, 3, 4)  # [L,P,2,H,hd]
-
-            def layer(x, inputs):
-                lp, pkv = inputs               # pkv [P, 2, H, hd]
-                y = _rmsnorm(x, lp["ln1"])
-                qkv = jnp.einsum("bsd,dkh->kbsh", y,
-                                 lp["wqkv"].astype(act))
-                q = qkv[0].reshape(1, t_pad, h, hd)
-                k = qkv[1].reshape(1, t_pad, h, hd)
-                v = qkv[2].reshape(1, t_pad, h, hd)
-                q = apply_rotary(q, cos, sin, tpos)
-                k = apply_rotary(k, cos, sin, tpos)
-                pk = pkv[None, :, 0]           # [1, P, H, hd]
-                pv = pkv[None, :, 1]
-                scale = hd ** -0.5
-                sc_p = jnp.einsum(
-                    "bqhd,bkhd->bhqk", q, pk,
-                    preferred_element_type=jnp.float32) * scale
-                sc_t = jnp.einsum(
-                    "bqhd,bkhd->bhqk", q, k,
-                    preferred_element_type=jnp.float32) * scale
-                sc_p = jnp.where(pref_valid[None, None, None, :],
-                                 sc_p, -1e30)
-                sc_t = jnp.where(causal_tt[None, None], sc_t, -1e30)
-                probs = jax.nn.softmax(
-                    jnp.concatenate([sc_p, sc_t], axis=-1),
-                    axis=-1).astype(act)
-                o = (jnp.einsum("bhqk,bkhd->bqhd",
-                                probs[..., :p_pad], pv)
-                     + jnp.einsum("bhqk,bkhd->bqhd",
-                                  probs[..., p_pad:], v))
-                x = x + (o.reshape(1, t_pad, h * hd)
-                         @ lp["wo"].astype(act))
-                y = _rmsnorm(x, lp["ln2"])
-                gu = jnp.einsum("bsd,dkf->kbsf", y,
-                                lp["w13"].astype(act))
-                x = x + (jax.nn.silu(gu[0]) * gu[1]) @ lp["w2"].astype(act)
-                kv = jnp.stack([k[0], v[0]], axis=1)   # [T, 2, H, hd]
-                return x, kv
-
-            x, kvs = jax.lax.scan(layer, x, (params["layers"], prefix_l))
-            x = _rmsnorm(x, params["ln_f"])
-            last = x[0, t_len - 1]
-            logits = jnp.einsum("d,vd->v", last,
-                                params["embed"].astype(act))
-            # kvs [L, T, 2, H, hd] -> [T, L, 2, H, hd]
-            return logits, kvs.transpose(1, 0, 2, 3, 4)
+            return self._prefill_cached_math(
+                params, tail_tokens, p_len, t_len, prefix, t_pad, p_pad)
 
         return jax.jit(run)
 
-    def _build_decode(self, b_pad: int, s_pad: int):
+    def _build_prefill_paged(self, t_pad: int, nbp_pad: int,
+                             block_size: int):
+        """Paged prefill-from-offset: the prefix is gathered from the
+        device pool INSIDE the jit via the block table — no host
+        materialization of the adopted prefix. Rows past `p_len` hold
+        whatever the gathered blocks contain (stale reused-block data
+        included); `pref_valid` masks them out of attention."""
+        import jax
+        import jax.numpy as jnp
+
+        self.jit_compiles += 1
+        p_pad = nbp_pad * block_size
+        kv_shape = self.kv_token_shape
+
+        def run(params, tail_tokens, p_len, t_len, pool, table):
+            # table [nbp_pad] int32, zero-padded (block 0 gathers are
+            # masked by pref_valid). pool [N, bs, L, 2, H, hd].
+            prefix = jnp.take(pool, table, axis=0).reshape(
+                (p_pad,) + kv_shape).astype(jnp.float32)
+            return self._prefill_cached_math(
+                params, tail_tokens, p_len, t_len, prefix, t_pad, p_pad)
+
+        return jax.jit(run)
+
+    def _decode_math(self, params, tokens, positions, cache,
+                     b_pad: int, s_pad: int):
+        """Traced body of one incremental step. `cache` rows past each
+        sequence's `position` may hold ANYTHING — zero padding on the
+        host-gather path, stale reused-block data on the paged path —
+        so the new token's K/V OVERWRITES its slot (`jnp.where`, not an
+        add) and `attend` masks everything past `position`."""
         import jax
         import jax.numpy as jnp
 
@@ -327,53 +461,93 @@ class TransformerEngineModel:
         h, hd = cfg.n_heads, cfg.head_dim
         rot1 = self._rot1
 
+        # tokens [B], positions [B], cache [B, S_pad, L, 2, H, hd].
+        act = jnp.float32
+        x = params["embed"][tokens].astype(act)       # [B, D]
+        cos, sin = rotary_freqs(hd, cfg.max_seq_len, cfg.rope_theta)
+        slot = (jnp.arange(s_pad)[None, :]
+                == positions[:, None])[:, :, None, None]   # [B,S,1,1]
+        attend = (jnp.arange(s_pad)[None, :]
+                  <= positions[:, None])               # [B, S]
+        cache = cache.transpose(2, 0, 1, 3, 4, 5)  # [L,B,S,2,H,hd]
+
+        def layer(x, inputs):
+            lp, kv_l = inputs          # kv_l [B, S, 2, H, hd]
+            y = _rmsnorm(x, lp["ln1"])
+            qkv = jnp.einsum("bd,dkh->kbh", y,
+                             lp["wqkv"].astype(act))
+            q = qkv[0].reshape(b_pad, h, hd)
+            k = qkv[1].reshape(b_pad, h, hd)
+            v = qkv[2].reshape(b_pad, h, hd)
+            q = rot1(q, cos, sin, positions)
+            k = rot1(k, cos, sin, positions)
+            keys = jnp.where(slot, k[:, None], kv_l[:, :, 0])
+            vals = jnp.where(slot, v[:, None], kv_l[:, :, 1])
+            scale = hd ** -0.5
+            scores = jnp.einsum(
+                "bhd,bshd->bhs", q, keys,
+                preferred_element_type=jnp.float32) * scale
+            scores = jnp.where(attend[:, None, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(act)
+            o = jnp.einsum("bhs,bshd->bhd", probs, vals)
+            x = x + o.reshape(b_pad, h * hd) @ lp["wo"].astype(act)
+            y = _rmsnorm(x, lp["ln2"])
+            gu = jnp.einsum("bd,dkf->kbf", y, lp["w13"].astype(act))
+            x = x + (jax.nn.silu(gu[0]) * gu[1]) @ lp["w2"].astype(act)
+            return x, jnp.stack([k, v], axis=1)   # [B, 2, H, hd]
+
+        x, new_kv = jax.lax.scan(layer, x, (params["layers"], cache))
+        x = _rmsnorm(x, params["ln_f"])
+        logits = jnp.einsum("bd,vd->bv", x,
+                            params["embed"].astype(act))
+        # new_kv [L, B, 2, H, hd] -> [B, L, 2, H, hd]
+        return logits, new_kv.transpose(1, 0, 2, 3, 4)
+
+    def _build_decode(self, b_pad: int, s_pad: int):
+        import jax
+
+        self.jit_compiles += 1
+
         def run(params, tokens, positions, cache):
-            # tokens [B], positions [B], cache [B, S_pad, L, 2, H, hd]
-            # (zero beyond each row's position).
-            act = jnp.float32
-            x = params["embed"][tokens].astype(act)       # [B, D]
-            cos, sin = rotary_freqs(hd, cfg.max_seq_len, cfg.rope_theta)
-            slot = jax.nn.one_hot(positions, s_pad, dtype=act)  # [B,S]
-            attend = (jnp.arange(s_pad)[None, :]
-                      <= positions[:, None])               # [B, S]
-            cache = cache.transpose(2, 0, 1, 3, 4, 5)  # [L,B,S,2,H,hd]
-
-            def layer(x, inputs):
-                lp, kv_l = inputs          # kv_l [B, S, 2, H, hd]
-                y = _rmsnorm(x, lp["ln1"])
-                qkv = jnp.einsum("bd,dkh->kbh", y,
-                                 lp["wqkv"].astype(act))
-                q = qkv[0].reshape(b_pad, h, hd)
-                k = qkv[1].reshape(b_pad, h, hd)
-                v = qkv[2].reshape(b_pad, h, hd)
-                q = rot1(q, cos, sin, positions)
-                k = rot1(k, cos, sin, positions)
-                # The new token's K/V lands in its own slot; the cache
-                # slot at `position` is zero by the manager's contract
-                # (blocks are allocated before being written).
-                keys = kv_l[:, :, 0] + slot[:, :, None, None] * k[:, None]
-                vals = kv_l[:, :, 1] + slot[:, :, None, None] * v[:, None]
-                scale = hd ** -0.5
-                scores = jnp.einsum(
-                    "bhd,bshd->bhs", q, keys,
-                    preferred_element_type=jnp.float32) * scale
-                scores = jnp.where(attend[:, None, :], scores, -1e30)
-                probs = jax.nn.softmax(scores, axis=-1).astype(act)
-                o = jnp.einsum("bhs,bshd->bhd", probs, vals)
-                x = x + o.reshape(b_pad, h * hd) @ lp["wo"].astype(act)
-                y = _rmsnorm(x, lp["ln2"])
-                gu = jnp.einsum("bd,dkf->kbf", y, lp["w13"].astype(act))
-                x = x + (jax.nn.silu(gu[0]) * gu[1]) @ lp["w2"].astype(act)
-                return x, jnp.stack([k, v], axis=1)   # [B, 2, H, hd]
-
-            x, new_kv = jax.lax.scan(layer, x, (params["layers"], cache))
-            x = _rmsnorm(x, params["ln_f"])
-            logits = jnp.einsum("bd,vd->bv", x,
-                                params["embed"].astype(act))
-            # new_kv [L, B, 2, H, hd] -> [B, L, 2, H, hd]
-            return logits, new_kv.transpose(1, 0, 2, 3, 4)
+            return self._decode_math(params, tokens, positions, cache,
+                                     b_pad, s_pad)
 
         return jax.jit(run)
+
+    def _build_decode_paged(self, b_pad: int, nb_pad: int,
+                            block_size: int):
+        """Fused paged decode step: gather, attend, AND write back in
+        one compiled call. The per-sequence KV is gathered from the
+        device pool INSIDE the jit — `jnp.take` over the padded block
+        tables, reshaped to the contiguous [B, S, ...] layout the core
+        attends over — and each new token's K/V is scattered into its
+        (block, off) slot before returning. The pool is DONATED: XLA
+        aliases input to output, so steady-state decode is one dispatch
+        with no pool copy and no KV payload crossing the host
+        boundary in either direction."""
+        import jax
+        import jax.numpy as jnp
+
+        self.jit_compiles += 1
+        s_pad = nb_pad * block_size
+        kv_shape = self.kv_token_shape
+
+        def run(pool, params, tokens, positions, tables, wblocks, woffs):
+            # tables [b_pad, nb_pad] int32, zero-padded (rows past the
+            # batch and blocks past a row's coverage gather block 0;
+            # `attend`/`slot` in the core mask the garbage). wblocks
+            # padding rows point past the pool, so mode="drop" skips
+            # them — dummy batch rows never touch real blocks.
+            flat = jnp.take(pool, tables.reshape(-1), axis=0)
+            cache = flat.reshape(
+                (b_pad, s_pad) + kv_shape).astype(jnp.float32)
+            logits, new_kv = self._decode_math(
+                params, tokens, positions, cache, b_pad, s_pad)
+            new_pool = pool.at[wblocks, woffs].set(
+                new_kv.astype(pool.dtype), mode="drop")
+            return logits, new_pool
+
+        return jax.jit(run, donate_argnums=0)
 
     # -- engine interface ----------------------------------------------
     def prefill(self, tokens: Sequence[int], prefix_kv=None):
@@ -435,3 +609,90 @@ class TransformerEngineModel:
         logits, new_kv = fn(self._params, jnp.asarray(toks),
                             jnp.asarray(poss), jnp.asarray(cache))
         return np.asarray(logits)[:b], np.asarray(new_kv)[:b]
+
+    def decode_paged(self, pool, block_tables: List[Sequence[int]],
+                     last_tokens: Sequence[int],
+                     positions: Sequence[int],
+                     write_blocks: Sequence[int],
+                     write_offs: Sequence[int], block_size: int):
+        """One fused incremental step reading KV straight out of the
+        device pool and writing the new tokens' KV back in-place. Host
+        work is O(B) table/token padding (int32 scalars); the KV
+        payload never touches the host. Returns host logits for the
+        sampler plus the post-write pool (the input pool was donated —
+        the caller MUST re-bind, e.g. via `KVCacheManager.paged_step`).
+        `write_blocks` may be shorter than the batch; missing rows (and
+        batch padding rows) scatter past the pool and are dropped, so
+        an empty write list is a read-only step."""
+        jnp = self._jnp
+        b = len(last_tokens)
+        if isinstance(pool, np.ndarray):
+            # Host-resident pool with paged tables: gather on host
+            # (still table-driven), step, write rows back in place.
+            kvs = []
+            for i in range(b):
+                n = int(positions[i])
+                nb_i = n // block_size + 1
+                idx = np.asarray(list(block_tables[i])[:nb_i], np.int64)
+                kvs.append(pool[idx].reshape(
+                    (-1,) + self.kv_token_shape)[:n])
+            logits, new_kv = self.decode(kvs, last_tokens, positions)
+            for i in range(min(len(write_blocks), b)):
+                pool[write_blocks[i], write_offs[i]] = new_kv[i]
+            return logits, pool
+        self.decode_calls += 1
+        b_pad = _next_pow2(max(b, 1))
+        nb = max(int(p) // block_size + 1 for p in positions)
+        nb_pad = _next_pow2(max(nb, 1))
+        key = (b_pad, nb_pad, block_size)
+        fn = self._decode_paged_jit.get(key)
+        if fn is None:
+            fn = self._decode_paged_jit[key] = \
+                self._build_decode_paged(*key)
+        num_blocks = int(pool.shape[0])
+        tables = np.zeros((b_pad, nb_pad), np.int32)
+        toks = np.zeros((b_pad,), np.int32)
+        poss = np.zeros((b_pad,), np.int32)
+        wb = np.full((b_pad,), num_blocks, np.int32)   # default: drop
+        wo = np.zeros((b_pad,), np.int32)
+        for i in range(b):
+            row = np.asarray(block_tables[i][:nb_pad], np.int32)
+            tables[i, :row.shape[0]] = row
+            toks[i] = int(last_tokens[i])
+            poss[i] = int(positions[i])
+        k = min(len(write_blocks), b)
+        wb[:k] = np.asarray(write_blocks[:k], np.int32)
+        wo[:k] = np.asarray(write_offs[:k], np.int32)
+        logits, new_pool = fn(pool, self._params, jnp.asarray(toks),
+                              jnp.asarray(poss), jnp.asarray(tables),
+                              jnp.asarray(wb), jnp.asarray(wo))
+        return np.asarray(logits)[:b], new_pool
+
+    def prefill_paged(self, tokens: Sequence[int], pool,
+                      block_table: Sequence[int], prefix_len: int,
+                      block_size: int):
+        """Prefill-from-offset with the adopted prefix gathered from
+        the device pool inside the jit. Returns host logits plus the
+        tail KV as a DEVICE array [tail, *kv_token_shape] for
+        `write_range`."""
+        jnp = self._jnp
+        self.prefill_calls += 1
+        n = len(tokens)
+        p = int(prefix_len)
+        t = n - p
+        self.prefill_tokens += t
+        t_pad = _next_pow2(max(t, 8))
+        nbp = (p + block_size - 1) // block_size
+        nbp_pad = _next_pow2(max(nbp, 1))
+        key = (t_pad, nbp_pad, block_size)
+        fn = self._prefill_paged_jit.get(key)
+        if fn is None:
+            fn = self._prefill_paged_jit[key] = \
+                self._build_prefill_paged(*key)
+        tail = np.zeros((t_pad,), np.int32)
+        tail[:t] = np.asarray(tokens[p:], np.int32)
+        table = np.zeros((nbp_pad,), np.int32)
+        table[:nbp] = np.asarray(block_table[:nbp], np.int32)
+        logits, kv = fn(self._params, jnp.asarray(tail), jnp.int32(p),
+                        jnp.int32(t), pool, jnp.asarray(table))
+        return np.asarray(logits), kv[:t]

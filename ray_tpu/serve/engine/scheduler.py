@@ -45,7 +45,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ray_tpu.core import flight
+from ray_tpu.core import attribution, flight
 from ray_tpu.serve.engine.kv_cache import CacheOverflowError, KVCacheManager
 from ray_tpu.serve.engine.prefix_index import PrefixIndex
 
@@ -72,6 +72,14 @@ class EngineConfig:
     kv_array_ns: Any = None        # numpy (default) or jax.numpy
     prefix_sharing: bool = True    # adopt cached prompt prefixes
     replica_tag: str = ""          # fleet identity (metrics/digests)
+    # Paged decode (PR 20): read KV inside the model's compiled step
+    # through block tables instead of host-gathering per sequence.
+    # Requires a model with `supports_paged`; falls back to the
+    # host-gather loop otherwise. `device_pool=None` follows
+    # `paged_decode` (a paged engine wants the pool device-resident so
+    # the in-jit gather is zero-copy); set explicitly to mix modes.
+    paged_decode: bool = False
+    device_pool: Optional[bool] = None
 
 
 class TokenStream:
@@ -207,11 +215,17 @@ class InferenceEngine:
         self.model = model
         self.config = config or EngineConfig()
         kv_shape = tuple(getattr(model, "kv_token_shape", ()))
+        self.paged = bool(self.config.paged_decode
+                          and getattr(model, "supports_paged", False))
+        device_pool = self.config.device_pool
+        if device_pool is None:
+            device_pool = self.paged
         self.cache = KVCacheManager(
             self.config.num_blocks, self.config.block_size,
             kv_shape=kv_shape,
             dtype=getattr(model, "kv_dtype", np.float32),
-            array_ns=self.config.kv_array_ns)
+            array_ns=self.config.kv_array_ns,
+            device_pool=bool(device_pool))
         self.prefix_index: Optional[PrefixIndex] = None
         if self.config.prefix_sharing:
             self.prefix_index = PrefixIndex(self.cache,
@@ -238,6 +252,12 @@ class InferenceEngine:
         self.finished = 0
         self.prefill_s = 0.0
         self.decode_s = 0.0
+        self.paged_steps = 0
+        # Decode-step phase split (the cost paged decode removes is the
+        # kv_gather slice): host gather / compiled step / cache write.
+        self.kv_gather_s = 0.0
+        self.model_step_s = 0.0
+        self.kv_write_s = 0.0
         self._ttfts: List[float] = []
         self._pushed: Dict[str, float] = {}
         # Retirement stamps feeding the queue-drain-rate estimate behind
@@ -470,12 +490,31 @@ class InferenceEngine:
             # adopted blocks — no prefill pass at all. (The returned
             # new_kv duplicates what the shared block already holds;
             # writing it would force a pointless COW, so drop it.)
-            ctx = self.cache.gather(seq.seq_id, n - 1)
-            logits, _ = self.model.decode([ctx], [tokens[-1]], [n - 1])
+            if self.paged:
+                table = self.cache.block_table(seq.seq_id)
+                # Empty write list = read-only fused step; mutate_pool
+                # re-binds the buffer the donating jit returns.
+                logits = self.cache.mutate_pool(
+                    lambda pool: self.model.decode_paged(
+                        pool, [table], [tokens[-1]], [n - 1], [], [],
+                        self.config.block_size))
+            else:
+                ctx = self.cache.gather(seq.seq_id, n - 1)
+                logits, _ = self.model.decode([ctx], [tokens[-1]],
+                                              [n - 1])
             logits = np.asarray(logits)[0]
         elif hit:
-            prefix_kv = self.cache.gather(seq.seq_id, hit)
-            if getattr(self.model, "supports_prefix_prefill", False):
+            if self.paged and hasattr(self.model, "prefill_paged"):
+                # Paged prefill-from-offset: the adopted prefix is
+                # gathered from the pool inside the jit — no host
+                # materialization of the matched head.
+                table = self.cache.block_table(seq.seq_id)
+                logits, tail_kv = self.cache.with_pool(
+                    lambda pool: self.model.prefill_paged(
+                        tokens, pool, table, hit,
+                        self.config.block_size))
+            elif getattr(self.model, "supports_prefix_prefill", False):
+                prefix_kv = self.cache.gather(seq.seq_id, hit)
                 logits, tail_kv = self.model.prefill(tokens, prefix_kv)
             else:
                 # Capacity-only sharing: the model recomputes the whole
@@ -563,18 +602,55 @@ class InferenceEngine:
 
     def _decode_once(self, batch: List[_Sequence]) -> None:
         t0 = time.perf_counter()
-        kvs = [self.cache.gather(s.seq_id) for s in batch]
         lasts = [s.all_tokens[-1] for s in batch]
         poss = [len(s.all_tokens) - 1 for s in batch]
-        logits, new_kv = self.model.decode(kvs, lasts, poss)
+        if self.paged:
+            # Paged: hand the model the POOL + block tables + write
+            # slots; gather, attention, AND the new tokens' KV
+            # write-back all run inside ONE donated jit call. Host work
+            # this step is int32 table padding — the KV payload never
+            # leaves the device in either direction.
+            tables = [self.cache.block_table(s.seq_id) for s in batch]
+            t1 = time.perf_counter()
+            logits = self.cache.paged_step(
+                [(s.seq_id, poss[i]) for i, s in enumerate(batch)],
+                lambda pool, blocks, offs: self.model.decode_paged(
+                    pool, tables, lasts, poss, blocks, offs,
+                    self.config.block_size))
+            t2 = time.perf_counter()
+            t3 = t2   # write is fused into the model step
+            self.paged_steps += 1
+        else:
+            kvs = [self.cache.gather(s.seq_id) for s in batch]
+            t1 = time.perf_counter()
+            logits, new_kv = self.model.decode(kvs, lasts, poss)
+            t2 = time.perf_counter()
+            for i, seq in enumerate(batch):
+                self.cache.write(seq.seq_id, poss[i], new_kv[i])
+            t3 = time.perf_counter()
         logits = np.asarray(logits)
-        dt = time.perf_counter() - t0
+        dt = t3 - t0
         self.decode_s += dt
+        self.kv_gather_s += t1 - t0
+        self.model_step_s += t2 - t1
+        self.kv_write_s += t3 - t2
+        if attribution.enabled:
+            attribution.record("engine.kv_gather", t1 - t0)
+            attribution.record("engine.model_step", t2 - t1)
+            attribution.record("engine.kv_write", t3 - t2)
         if flight.enabled:
+            now = time.monotonic()
             flight.record("engine", "decode", dur_us=int(dt * 1e6),
-                          arg=len(batch), t=time.monotonic() - dt)
+                          arg=len(batch), t=now - dt)
+            # Phase split inside the step: before/after this PR the
+            # kv_gather span is what shrinks in /api/timeline.
+            flight.record("engine", "kv_gather",
+                          dur_us=int((t1 - t0) * 1e6),
+                          arg=len(batch), t=now - dt)
+            flight.record("engine", "model_step",
+                          dur_us=int((t2 - t1) * 1e6),
+                          arg=len(batch), t=now - dt + (t1 - t0))
         for i, seq in enumerate(batch):
-            self.cache.write(seq.seq_id, poss[i], new_kv[i])
             tok = int(np.argmax(logits[i]))
             self._emit(seq, tok)
             self._maybe_finish(seq)
@@ -686,8 +762,15 @@ class InferenceEngine:
             "cache": self.cache.stats(),
             "prefix_index": (self.prefix_index.stats()
                              if self.prefix_index is not None else None),
+            "paged": self.paged,
+            "paged_steps": self.paged_steps,
+            "jit_bucket_evictions": getattr(
+                self.model, "jit_cache_evictions", 0),
             "prefill_s": round(self.prefill_s, 6),
             "decode_s": round(self.decode_s, 6),
+            "kv_gather_s": round(self.kv_gather_s, 6),
+            "model_step_s": round(self.model_step_s, 6),
+            "kv_write_s": round(self.kv_write_s, 6),
             "ttft_p50_ms": (round(ttfts[len(ttfts) // 2] * 1e3, 3)
                             if ttfts else None),
         }
@@ -713,13 +796,25 @@ class InferenceEngine:
                     m[key].inc(cur - last)
                     self._pushed[attr] = cur
             for attr, phase in (("prefill_s", "prefill"),
-                                ("decode_s", "decode")):
+                                ("decode_s", "decode"),
+                                ("kv_gather_s", "kv_gather"),
+                                ("model_step_s", "model_step"),
+                                ("kv_write_s", "kv_write")):
                 cur = getattr(self, attr)
                 last = self._pushed.get(attr, 0.0)
                 if cur > last:
                     m["step_phase"].inc(cur - last,
                                         tags={"phase": phase})
                     self._pushed[attr] = cur
+            m["kv_pool_bytes"].set(
+                float(self.cache.pool_bytes),
+                tags={"replica": self.replica_tag,
+                      "residency": self.cache.pool_residency})
+            evs = int(getattr(self.model, "jit_cache_evictions", 0))
+            last_ev = self._pushed.get("jit_evictions", 0)
+            if evs > last_ev:
+                m["jit_evictions"].inc(evs - last_ev)
+                self._pushed["jit_evictions"] = evs
             if self.prefix_index is not None:
                 # Per-replica radix-index state on the scrape path —
                 # the dashboard's /api/serve `prefix` section and the
